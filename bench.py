@@ -1,0 +1,83 @@
+"""Headline benchmark (BASELINE.json:2): FL rounds/sec and
+client-updates/sec/chip on the 100-client CIFAR-10 ResNet-18 config.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is relative to OUR first recorded TPU measurement in
+BASELINE.md (the reference publishes no numbers — BASELINE.json:13
+``"published": {}`` — so our own first light-up is the baseline the
+driver tracks improvement against).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# First recorded rounds/sec on 1× TPU v5 lite (see BASELINE.md measurements
+# table): 2026-07-29, commit of milestone S0-S2.
+BASELINE_ROUNDS_PER_SEC = 2.22
+
+WARMUP_ROUNDS = 2
+TIMED_ROUNDS = 8
+
+
+def main():
+    import jax
+
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    cfg = get_named_config("cifar10_fedavg_100")
+    cfg.server.num_rounds = WARMUP_ROUNDS + TIMED_ROUNDS
+    cfg.server.eval_every = 0
+    cfg.server.checkpoint_every = 0
+    cfg.run.out_dir = ""
+    # synthetic CIFAR-sized corpus (real CIFAR absent in this sandbox: zero
+    # egress). Same shapes/cardinality as the real thing: 50k train examples.
+    cfg.data.synthetic_train_size = 50_000
+    cfg.data.synthetic_test_size = 1_000
+
+    exp = Experiment(cfg, echo=False)
+    state = exp.init_state()
+    state = exp._place_state(state)
+
+    # Each round's train-loss scalar is fetched inside the timed region —
+    # that is what the real driver does every round, and it forces true
+    # execution (block_until_ready alone does not sync through the axon
+    # remote-execution relay).
+    last_loss = 0.0
+    for r in range(WARMUP_ROUNDS):
+        state = exp.run_round(state, r)
+        last_loss = float(state.pop("_metrics").train_loss)
+
+    t0 = time.perf_counter()
+    for r in range(WARMUP_ROUNDS, WARMUP_ROUNDS + TIMED_ROUNDS):
+        state = exp.run_round(state, r)
+        last_loss = float(state.pop("_metrics").train_loss)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = TIMED_ROUNDS / dt
+    updates_per_sec_per_chip = (
+        TIMED_ROUNDS * cfg.server.cohort_size / dt / exp.n_chips
+    )
+    vs = rounds_per_sec / BASELINE_ROUNDS_PER_SEC if BASELINE_ROUNDS_PER_SEC else 1.0
+    print(json.dumps({
+        "metric": "FL rounds/sec (100-client CIFAR-10, ResNet-18, cohort 16)",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(vs, 4),
+        "extra": {
+            "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
+            "n_chips": exp.n_chips,
+            "timed_rounds": TIMED_ROUNDS,
+            "platform": jax.devices()[0].platform,
+            "data_source": exp.fed.meta.get("source"),
+            "final_train_loss": round(last_loss, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""`colearn` CLI (SURVEY.md §2 C1, layer L6).
+
+Entry points with capability parity to the reference's
+``colearn fit`` / ``colearn evaluate`` (BASELINE.json:5)::
+
+    colearn fit --config cifar10_fedavg_100 --set server.num_rounds=50
+    colearn evaluate --config cifar10_fedavg_100
+    colearn configs            # list the named BASELINE configs
+
+``--config`` accepts a registry name or a YAML path; ``--set a.b=v``
+overrides any field. ``fit --resume`` continues from the latest
+checkpoint; ``--profile N`` traces round N with jax.profiler;
+``--sanitize`` enables NaN debugging + finite-params assertions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_overrides(pairs):
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise SystemExit(f"--set expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        lowered = v.lower()
+        if lowered in ("true", "false"):
+            out[k] = lowered == "true"
+        else:
+            try:
+                out[k] = int(v)
+            except ValueError:
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    out[k] = v
+    return out
+
+
+def _add_common(p):
+    p.add_argument("--config", required=True,
+                   help="named config (see `colearn configs`) or YAML path")
+    p.add_argument("--set", action="append", metavar="KEY=VALUE", dest="overrides",
+                   help="dotted config override, e.g. server.num_rounds=5")
+    p.add_argument("--out-dir", default=None, help="override run.out_dir")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="colearn",
+                                description="TPU-native federated learning simulation")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    fit = sub.add_parser("fit", help="run federated training")
+    _add_common(fit)
+    fit.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
+    fit.add_argument("--profile", type=int, default=None, metavar="ROUND",
+                     help="jax.profiler trace of round ROUND")
+    fit.add_argument("--sanitize", action="store_true",
+                     help="NaN debugging + finite-params checks")
+    fit.add_argument("--engine", choices=["sharded", "sequential"], default=None)
+
+    ev = sub.add_parser("evaluate", help="evaluate latest (or --step) checkpoint")
+    _add_common(ev)
+    ev.add_argument("--step", type=int, default=None, help="checkpoint round to load")
+
+    sub.add_parser("configs", help="list named configs")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    # deferred imports keep `colearn configs --help` fast
+    from colearn_federated_learning_tpu.config import list_named_configs, resolve_config
+
+    if args.cmd == "configs":
+        for name in list_named_configs():
+            print(name)
+        return 0
+
+    overrides = _parse_overrides(args.overrides)
+    if args.out_dir is not None:
+        overrides["run.out_dir"] = args.out_dir
+    if args.cmd == "fit":
+        if args.resume:
+            overrides["run.resume"] = True
+        if args.profile is not None:
+            overrides["run.profile_round"] = args.profile
+        if args.sanitize:
+            overrides["run.sanitize"] = True
+        if args.engine:
+            overrides["run.engine"] = args.engine
+    try:
+        cfg = resolve_config(args.config, overrides)
+    except (KeyError, ValueError, FileNotFoundError) as e:
+        msg = e.args[0] if e.args else str(e)
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    try:
+        exp = Experiment(cfg)
+    except (ValueError, KeyError, FileNotFoundError) as e:
+        # configuration-shaped failures get a clean one-liner; genuine
+        # runtime errors below still surface with full tracebacks
+        print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+        return 2
+    if args.cmd == "fit":
+        state = exp.fit()
+        final = {"event": "done", "rounds": int(state["round"]),
+                 "wall_time_sec": round(state.get("wall_time", 0.0), 2)}
+        final.update(exp.evaluate(state["params"]))
+        print(json.dumps(final))
+        return 0
+    if args.cmd == "evaluate":
+        print(json.dumps(exp.evaluate_checkpoint(step=args.step)))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

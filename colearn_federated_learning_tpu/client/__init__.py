@@ -1,0 +1,1 @@
+"""Client-side local training (layer L2)."""

@@ -1,0 +1,135 @@
+"""Per-client local trainer (SURVEY.md §2 C5/C7, call stack §3.3).
+
+The reference's client loop is E epochs of minibatch SGD on torch.cuda
+(BASELINE.json:5). Here it is one pure function::
+
+    (global_params, data_refs, idx[steps,batch], mask[steps,batch], rng)
+        → (local_params, metrics)
+
+with ``lax.scan`` over the step axis so the entire local phase is a
+single fused XLA computation — no host round-trips, no Python in the
+loop. Batches are gathered **inside** the scan step from HBM-resident
+example arrays (``jnp.take``), so peak memory is one batch, not
+steps×batch (essential for the ViT silo config).
+
+Algorithm hooks:
+- FedProx (C7): the proximal term μ/2‖w−w₀‖² enters as the exact
+  gradient contribution μ·(w−w₀) added to the batch gradient — the
+  identity the unit tests pin (SURVEY.md §4.1).
+- DP-SGD (C12): per-example clipped + noised gradients replace the
+  batch gradient (privacy/dp.py).
+- Padded steps (mask all-zero) are algebraic no-ops: the parameter and
+  optimizer-state updates are gated on step validity, so heterogeneous
+  clients running out of data early do not drift via momentum decay.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from colearn_federated_learning_tpu.config import ClientConfig, DPConfig
+from colearn_federated_learning_tpu.privacy import dp as dp_lib
+from colearn_federated_learning_tpu.utils import trees
+
+
+class LocalMetrics(NamedTuple):
+    loss: jnp.ndarray  # mask-weighted mean train loss over the round
+    examples: jnp.ndarray  # real examples processed
+
+
+def make_client_optimizer(cfg: ClientConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "sgd":
+        opt = optax.sgd(cfg.lr, momentum=cfg.momentum if cfg.momentum else None)
+    elif cfg.optimizer == "adamw":
+        opt = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"unknown client optimizer {cfg.optimizer!r}")
+    if cfg.optimizer == "sgd" and cfg.weight_decay:
+        opt = optax.chain(optax.add_decayed_weights(cfg.weight_decay), opt)
+    return opt
+
+
+def make_loss_fn(model, task: str):
+    """Masked-mean loss. classify: y [B] ints; lm: y [B,T] next tokens."""
+
+    def loss_fn(params, x, y, m):
+        logits = model.apply({"params": params}, x, train=True)
+        if task == "classify":
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        else:  # lm: mean over tokens within each example
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(-1)
+        denom = jnp.maximum(m.sum(), 1.0)
+        return (ce * m).sum() / denom
+
+    return loss_fn
+
+
+def _select_tree(pred, new, old):
+    return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
+
+
+def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str):
+    """Build the pure local-training function for one client-round."""
+    opt = make_client_optimizer(client_cfg)
+    loss_fn = make_loss_fn(model, task)
+    grad_fn = jax.value_and_grad(loss_fn)
+    mu = client_cfg.prox_mu
+    if dp_cfg.enabled:
+        dp_grad_fn = dp_lib.make_dp_grad_fn(loss_fn, dp_cfg)
+
+    def local_train(global_params, train_x, train_y, idx, mask, rng):
+        """idx/mask: [steps, batch]; returns (params, LocalMetrics)."""
+
+        def step(carry, inp):
+            params, opt_state = carry
+            step_idx, step_mask, key = inp
+            x = jnp.take(train_x, step_idx, axis=0)
+            y = jnp.take(train_y, step_idx, axis=0)
+            if dp_cfg.enabled:
+                loss, grads = dp_grad_fn(params, x, y, step_mask, key)
+            else:
+                loss, grads = grad_fn(params, x, y, step_mask)
+            if mu > 0.0:
+                # exact ∇ of μ/2‖w−w₀‖² — FedProx's proximal pull
+                grads = jax.tree.map(
+                    lambda g, p, p0: g + mu * (p - p0), grads, params, global_params
+                )
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            valid = step_mask.sum() > 0
+            params = _select_tree(valid, new_params, params)
+            opt_state = _select_tree(valid, new_opt_state, opt_state)
+            return (params, opt_state), loss * step_mask.sum()
+
+        steps = idx.shape[0]
+        keys = jax.random.split(rng, steps)
+        (params, _), weighted_losses = jax.lax.scan(
+            step, (global_params, opt.init(global_params)), (idx, mask, keys)
+        )
+        n = mask.sum()
+        mean_loss = weighted_losses.sum() / jnp.maximum(n, 1.0)
+        return params, LocalMetrics(loss=mean_loss, examples=n)
+
+    return local_train
+
+
+def make_eval_fn(model, task: str):
+    """Jitted masked eval on one batch → (sum_loss, sum_correct, n)."""
+    loss_core = make_loss_fn(model, task)
+    del loss_core  # eval computes sums, not means; kept for symmetry
+
+    def eval_batch(params, x, y, m):
+        logits = model.apply({"params": params}, x, train=False)
+        if task == "classify":
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(-1)
+            correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32).mean(-1)
+        return (ce * m).sum(), (correct * m).sum(), m.sum()
+
+    return eval_batch

@@ -1,0 +1,324 @@
+"""Experiment configuration system (SURVEY.md §2 C2, layer L5).
+
+Typed dataclass configs + YAML files + the five named BASELINE configs
+(BASELINE.json:7-11). ``colearn fit --config <name-or-path>`` resolves a
+name through :func:`get_named_config` or loads a YAML file; dotted CLI
+overrides (``--set server.num_rounds=5``) mutate fields after load.
+
+Everything that affects traced XLA shapes (cohort size, local steps,
+batch size, pad length) is pinned here so a config change — not runtime
+data — is the only thing that can trigger recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import yaml
+
+
+@dataclass
+class ModelConfig:
+    name: str = "lenet5"
+    num_classes: int = 10
+    # model-family extras (e.g. vocab_size / seq_len for LMs, image_size)
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DataConfig:
+    name: str = "mnist"
+    num_clients: int = 2
+    partition: str = "iid"  # iid | dirichlet | natural | silo
+    dirichlet_alpha: float = 0.5
+    data_dir: str = "~/.cache/colearn_data"
+    # When real dataset files are absent (this sandbox has zero egress),
+    # fall back to a deterministic synthetic dataset with the same
+    # shapes/cardinality so every config stays runnable end-to-end.
+    synthetic_fallback: bool = True
+    synthetic_train_size: int = 2048
+    synthetic_test_size: int = 512
+    # Cap on examples a client contributes per round (static-shape pad target;
+    # 0 = derive from the largest client shard).
+    max_examples_per_client: int = 0
+
+
+@dataclass
+class ClientConfig:
+    local_epochs: int = 1
+    batch_size: int = 32
+    optimizer: str = "sgd"  # sgd | adamw
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    # FedProx proximal coefficient μ (0.0 == plain FedAvg local training)
+    prox_mu: float = 0.0
+
+
+@dataclass
+class ServerConfig:
+    num_rounds: int = 10
+    cohort_size: int = 2
+    eval_every: int = 1
+    checkpoint_every: int = 0  # 0 = only at end
+    # Server-side optimizer applied to the aggregated delta:
+    #   mean (plain FedAvg) | fedavgm (server momentum) | fedadam
+    optimizer: str = "mean"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
+    # Simulated client dropout: fraction of the sampled cohort whose
+    # update is zeroed inside the round function (straggler model).
+    dropout_rate: float = 0.0
+
+
+@dataclass
+class DPConfig:
+    enabled: bool = False
+    l2_clip: float = 1.0
+    noise_multiplier: float = 1.0
+    delta: float = 1e-5
+    # per-example grads are memory-heavy; vmap over microbatches of this size
+    microbatch_size: int = 16
+
+
+@dataclass
+class RunConfig:
+    seed: int = 0
+    # sharded: the shard_map/psum round engine (one XLA program per round)
+    # sequential: python loop over cohort clients (reference semantics; used
+    #             for bit-parity tests and single-device debugging)
+    engine: str = "sharded"
+    # number of mesh lanes on the "clients" axis; 0 = all visible devices
+    num_lanes: int = 0
+    # second mesh axis for intra-client batch DP on big silo models; 1 = off
+    batch_shards: int = 1
+    out_dir: str = "runs"
+    resume: bool = False
+    profile_round: int = -1  # round index to wrap in jax.profiler.trace; -1 = off
+    sanitize: bool = False  # jax_debug_nans + finite-params assertions
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # bfloat16 on real TPU configs
+
+
+@dataclass
+class ExperimentConfig:
+    name: str = "mnist_fedavg_2"
+    algorithm: str = "fedavg"  # fedavg | fedprox (prox_mu>0 implied)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    dp: DPConfig = field(default_factory=DPConfig)
+    run: RunConfig = field(default_factory=RunConfig)
+
+    def validate(self) -> "ExperimentConfig":
+        if self.server.cohort_size > self.data.num_clients:
+            raise ValueError(
+                f"cohort_size {self.server.cohort_size} > num_clients {self.data.num_clients}"
+            )
+        if self.algorithm == "fedprox" and self.client.prox_mu <= 0:
+            raise ValueError("fedprox requires client.prox_mu > 0")
+        if self.algorithm not in ("fedavg", "fedprox"):
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.run.engine not in ("sharded", "sequential"):
+            raise ValueError(f"unknown engine {self.run.engine!r}")
+        return self
+
+    # ---- serialization ------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ExperimentConfig":
+        def build(dc_cls, sub):
+            fields = {f.name: f for f in dataclasses.fields(dc_cls)}
+            kwargs = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    raise KeyError(f"unknown config key {k!r} for {dc_cls.__name__}")
+                f = fields[k]
+                if dataclasses.is_dataclass(f.type) or f.name in _NESTED:
+                    kwargs[k] = build(_NESTED[f.name], v)
+                else:
+                    kwargs[k] = v
+            return dc_cls(**kwargs)
+
+        _NESTED = {
+            "model": ModelConfig,
+            "data": DataConfig,
+            "client": ClientConfig,
+            "server": ServerConfig,
+            "dp": DPConfig,
+            "run": RunConfig,
+        }
+        return build(cls, d)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ExperimentConfig":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def to_yaml(self, path: str) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+    def apply_overrides(self, overrides: Dict[str, Any]) -> "ExperimentConfig":
+        """Apply dotted-path overrides like {'server.num_rounds': 5}.
+
+        Paths may descend into dict-typed fields (``model.kwargs.seq_len``).
+        """
+        for dotted, value in overrides.items():
+            obj = self
+            *head, last = dotted.split(".")
+            for part in head:
+                obj = obj[part] if isinstance(obj, dict) else getattr(obj, part)
+            if isinstance(obj, dict):
+                obj[last] = value
+                continue
+            if not hasattr(obj, last):
+                raise KeyError(f"unknown config path {dotted!r}")
+            current = getattr(obj, last)
+            if current is not None and not isinstance(current, dict):
+                value = type(current)(value) if not isinstance(value, type(current)) else value
+            setattr(obj, last, value)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# The five named BASELINE configs (BASELINE.json:7-11)
+# ---------------------------------------------------------------------------
+
+
+def _mnist_fedavg_2() -> ExperimentConfig:
+    """BASELINE config #1: FedAvg, 2 clients, LeNet-5 on MNIST (CPU smoke)."""
+    return ExperimentConfig(
+        name="mnist_fedavg_2",
+        algorithm="fedavg",
+        model=ModelConfig(name="lenet5", num_classes=10),
+        data=DataConfig(name="mnist", num_clients=2, partition="iid"),
+        client=ClientConfig(local_epochs=1, batch_size=32, lr=0.1),
+        server=ServerConfig(num_rounds=20, cohort_size=2),
+    )
+
+
+def _cifar10_fedavg_100() -> ExperimentConfig:
+    """BASELINE config #2: FedAvg, 100 clients, ResNet-18 on CIFAR-10 Dirichlet.
+
+    The headline-metric config (BASELINE.json:2): FL rounds/sec and
+    client-updates/sec/chip are measured here.
+    """
+    return ExperimentConfig(
+        name="cifar10_fedavg_100",
+        algorithm="fedavg",
+        model=ModelConfig(name="resnet18", num_classes=10),
+        data=DataConfig(
+            name="cifar10",
+            num_clients=100,
+            partition="dirichlet",
+            dirichlet_alpha=0.5,
+            max_examples_per_client=512,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
+        server=ServerConfig(num_rounds=500, cohort_size=16, eval_every=10),
+        run=RunConfig(compute_dtype="bfloat16"),
+    )
+
+
+def _femnist_fedprox_500() -> ExperimentConfig:
+    """BASELINE config #3: FedProx, 500 clients, MobileNetV2 on FEMNIST (LEAF)."""
+    return ExperimentConfig(
+        name="femnist_fedprox_500",
+        algorithm="fedprox",
+        model=ModelConfig(name="mobilenetv2", num_classes=62, kwargs={"width_mult": 1.0}),
+        data=DataConfig(
+            name="femnist",
+            num_clients=500,
+            partition="natural",
+            max_examples_per_client=256,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=32, lr=0.03, prox_mu=0.01),
+        server=ServerConfig(num_rounds=500, cohort_size=16, eval_every=10),
+        run=RunConfig(compute_dtype="bfloat16"),
+    )
+
+
+def _shakespeare_fedavg() -> ExperimentConfig:
+    """BASELINE config #4: FedAvg, BERT-tiny next-token LM on Shakespeare (LEAF)."""
+    return ExperimentConfig(
+        name="shakespeare_fedavg",
+        algorithm="fedavg",
+        model=ModelConfig(
+            name="bert_tiny",
+            num_classes=0,
+            kwargs={"vocab_size": 90, "seq_len": 80},
+        ),
+        data=DataConfig(
+            name="shakespeare",
+            num_clients=128,
+            partition="natural",
+            max_examples_per_client=256,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=16, lr=0.5),
+        server=ServerConfig(num_rounds=200, cohort_size=8, eval_every=10),
+        run=RunConfig(compute_dtype="bfloat16"),
+    )
+
+
+def _imagenet_silo_dp() -> ExperimentConfig:
+    """BASELINE config #5: cross-silo FedAvg + DP-SGD, ViT-B/16, 32 silos."""
+    return ExperimentConfig(
+        name="imagenet_silo_dp",
+        algorithm="fedavg",
+        model=ModelConfig(
+            name="vit_b16", num_classes=1000, kwargs={"image_size": 224}
+        ),
+        data=DataConfig(
+            name="imagenet_federated",
+            num_clients=32,
+            partition="silo",
+            max_examples_per_client=1024,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=64, lr=0.003, optimizer="adamw"),
+        server=ServerConfig(num_rounds=100, cohort_size=32, eval_every=5),
+        dp=DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=0.8, microbatch_size=8),
+        run=RunConfig(compute_dtype="bfloat16"),
+    )
+
+
+_NAMED = {
+    "mnist_fedavg_2": _mnist_fedavg_2,
+    "cifar10_fedavg_100": _cifar10_fedavg_100,
+    "femnist_fedprox_500": _femnist_fedprox_500,
+    "shakespeare_fedavg": _shakespeare_fedavg,
+    "imagenet_silo_dp": _imagenet_silo_dp,
+}
+
+
+def get_named_config(name: str) -> ExperimentConfig:
+    try:
+        return _NAMED[name]().validate()
+    except KeyError:
+        raise KeyError(f"unknown named config {name!r}; known: {sorted(_NAMED)}") from None
+
+
+def list_named_configs():
+    return sorted(_NAMED)
+
+
+def resolve_config(name_or_path: str, overrides: Optional[Dict[str, Any]] = None) -> ExperimentConfig:
+    """Resolve a config by registry name or YAML path, then apply overrides."""
+    if name_or_path in _NAMED:
+        cfg = get_named_config(name_or_path)
+    elif name_or_path.endswith((".yaml", ".yml")) or "/" in name_or_path:
+        cfg = ExperimentConfig.from_yaml(name_or_path)
+    else:
+        raise KeyError(
+            f"unknown config {name_or_path!r}; known named configs: "
+            f"{sorted(_NAMED)} (or pass a .yaml path)"
+        )
+    if overrides:
+        cfg.apply_overrides(overrides)
+    return cfg.validate()

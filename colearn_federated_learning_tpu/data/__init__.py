@@ -1,0 +1,14 @@
+"""Data layer (SURVEY.md §2 C10/C11, layer L1).
+
+Dataset registry + federated partitioners + the static-shape round-batch
+index builder. The design splits "bytes" from "structure": example
+arrays live once in HBM (device-resident), while per-round client
+batches are tiny int32 index tensors generated on host — the host never
+touches example data inside the round loop.
+"""
+
+from colearn_federated_learning_tpu.data.core import (  # noqa: F401
+    FederatedData,
+    build_federated_data,
+    dataset_registry,
+)

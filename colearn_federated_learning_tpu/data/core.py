@@ -1,0 +1,227 @@
+"""Dataset registry and loaders (SURVEY.md §2 C10).
+
+Capability parity targets (BASELINE.json:7-11): MNIST, CIFAR-10, LEAF
+FEMNIST, LEAF Shakespeare, federated ImageNet.
+
+Each loader first looks for real data files under ``data_dir`` (the
+formats a user would naturally drop in: keras-style ``mnist.npz``,
+CIFAR-10 python pickles, LEAF ``all_data.json``); this sandbox has zero
+egress so when files are absent and ``synthetic_fallback`` is enabled a
+**deterministic, learnable synthetic stand-in** with identical shapes,
+dtypes and class structure is generated instead — class-template images
+(or a fixed Markov chain for text) plus noise, so convergence tests are
+meaningful, not vacuous. The provenance is recorded in ``meta.source``
+so benchmarks/logs can never silently confuse the two.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import numpy as np
+
+from colearn_federated_learning_tpu.config import DataConfig
+from colearn_federated_learning_tpu.data import partition as partition_lib
+from colearn_federated_learning_tpu.utils.registry import Registry
+
+dataset_registry = Registry("dataset")
+
+
+@dataclass
+class FederatedData:
+    """A dataset plus its federated structure.
+
+    ``train_x``/``train_y`` are flat example arrays; the federation is the
+    ``client_indices`` list (one int array of example ids per client) —
+    partitioning is metadata, the bytes are stored once.
+
+    task: "classify" (y: [N] int labels) or "lm" (x: [N,T] tokens,
+    y: [N,T] next-token targets).
+    """
+
+    train_x: np.ndarray
+    train_y: np.ndarray
+    test_x: np.ndarray
+    test_y: np.ndarray
+    client_indices: List[np.ndarray]
+    num_classes: int
+    task: str = "classify"
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.client_indices)
+
+    def client_sizes(self) -> np.ndarray:
+        return np.array([len(ix) for ix in self.client_indices], np.int64)
+
+
+# ---------------------------------------------------------------------------
+# synthetic generators (deterministic, learnable)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_images(rng: np.random.Generator, n: int, templates: np.ndarray):
+    """Class-template images + noise: x = 0.7·template[y] + 0.3·noise.
+
+    The SAME templates generate train and test (only noise and label draws
+    differ), so the task is learnable by a small convnet in a handful of
+    rounds — what the convergence smoke tests (SURVEY.md §4.2) need.
+    """
+    num_classes, shape = templates.shape[0], templates.shape[1:]
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    noise = rng.uniform(0.0, 1.0, size=(n,) + tuple(shape)).astype(np.float32)
+    x = 0.7 * templates[y] + 0.3 * noise
+    return x.astype(np.float32), y
+
+
+def _synthetic_text(rng: np.random.Generator, n: int, seq_len: int, vocab: int):
+    """Sequences from a fixed sparse Markov chain → next-token prediction is
+    learnable well above chance (each symbol has ~4 plausible successors)."""
+    successors = rng.integers(0, vocab, size=(vocab, 4))
+    seqs = np.empty((n, seq_len + 1), np.int32)
+    state = rng.integers(0, vocab, size=n)
+    seqs[:, 0] = state
+    for t in range(1, seq_len + 1):
+        choice = rng.integers(0, 4, size=n)
+        state = successors[seqs[:, t - 1], choice]
+        seqs[:, t] = state
+    return seqs[:, :-1].copy(), seqs[:, 1:].copy()
+
+
+# ---------------------------------------------------------------------------
+# loaders — real files when present, synthetic stand-in otherwise
+# ---------------------------------------------------------------------------
+
+
+def _stable_seed(name: str) -> int:
+    # abs(hash()) is salted per-process; datasets must be reproducible
+    return int.from_bytes(name.encode(), "little") % (2**31)
+
+
+def _scaled_train_size(cfg: DataConfig) -> int:
+    """Synthetic corpora must be big enough to partition: ≥32 examples per
+    client on average, or the Dirichlet/natural min_size retry can't succeed
+    (e.g. 500 FEMNIST clients over the 2048-example default)."""
+    return max(cfg.synthetic_train_size, cfg.num_clients * 32)
+
+
+def _image_loader(name: str, shape, num_classes: int, real_fn):
+    def load(cfg: DataConfig, **kwargs):
+        data_dir = os.path.expanduser(cfg.data_dir)
+        real = real_fn(data_dir) if real_fn else None
+        extra_meta = {}
+        if real is not None:
+            if len(real) == 5:  # loader supplies meta (e.g. natural_groups)
+                tx, ty, ex, ey, extra_meta = real
+            else:
+                tx, ty, ex, ey = real
+            source = "real"
+        elif cfg.synthetic_fallback:
+            rng = np.random.default_rng(_stable_seed(name))
+            templates = rng.uniform(
+                0.0, 1.0, size=(num_classes,) + tuple(shape)
+            ).astype(np.float32)
+            n_train = _scaled_train_size(cfg)
+            tx, ty = _synthetic_images(rng, n_train, templates)
+            ex, ey = _synthetic_images(rng, cfg.synthetic_test_size, templates)
+            source = "synthetic"
+        else:
+            raise FileNotFoundError(
+                f"{name}: no data under {data_dir} and synthetic_fallback=False"
+            )
+        meta = {"source": source, "input_shape": tuple(shape), **extra_meta}
+        return tx, ty, ex, ey, meta, num_classes, "classify"
+
+    return load
+
+
+def _try_mnist_real(data_dir: str):
+    path = os.path.join(data_dir, "mnist.npz")
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as d:
+        tx = (d["x_train"].astype(np.float32) / 255.0)[..., None]
+        ex = (d["x_test"].astype(np.float32) / 255.0)[..., None]
+        return tx, d["y_train"].astype(np.int32), ex, d["y_test"].astype(np.int32)
+
+
+def _try_cifar10_real(data_dir: str):
+    base = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(base):
+        return None
+    def read(fname):
+        with open(os.path.join(base, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x.astype(np.float32) / 255.0, np.array(d[b"labels"], np.int32)
+    xs, ys = zip(*[read(f"data_batch_{i}") for i in range(1, 6)])
+    tx, ty = np.concatenate(xs), np.concatenate(ys)
+    ex, ey = read("test_batch")
+    return tx, ty, ex, ey
+
+
+def _try_femnist_real(data_dir: str):
+    if not os.path.isdir(os.path.join(data_dir, "femnist")):
+        return None
+    from colearn_federated_learning_tpu.data.leaf import load_femnist
+
+    return load_femnist(data_dir)
+
+
+dataset_registry.register("mnist")(_image_loader("mnist", (28, 28, 1), 10, _try_mnist_real))
+dataset_registry.register("cifar10")(_image_loader("cifar10", (32, 32, 3), 10, _try_cifar10_real))
+dataset_registry.register("femnist")(
+    _image_loader("femnist", (28, 28, 1), 62, _try_femnist_real)
+)
+# Federated ImageNet (cross-silo): synthetic stand-in uses a reduced 64×64
+# geometry by default to keep the sandbox runnable; the silo config overrides
+# image_size for real runs.
+dataset_registry.register("imagenet_federated")(
+    _image_loader("imagenet_federated", (64, 64, 3), 1000, None)
+)
+
+
+@dataset_registry.register("shakespeare")
+def _load_shakespeare(cfg: DataConfig, vocab_size: int = 90, seq_len: int = 80, **kwargs):
+    data_dir = os.path.expanduser(cfg.data_dir)
+    txt = os.path.join(data_dir, "shakespeare.txt")
+    if os.path.exists(txt):
+        from colearn_federated_learning_tpu.data.leaf import load_shakespeare_text
+        tx, ty, ex, ey, meta = load_shakespeare_text(txt, vocab_size, seq_len)
+        return tx, ty, ex, ey, meta, vocab_size, "lm"
+    if not cfg.synthetic_fallback:
+        raise FileNotFoundError(f"shakespeare: no data under {data_dir}")
+    rng = np.random.default_rng(1207)
+    tx, ty = _synthetic_text(rng, _scaled_train_size(cfg), seq_len, vocab_size)
+    ex, ey = _synthetic_text(rng, cfg.synthetic_test_size, seq_len, vocab_size)
+    return tx, ty, ex, ey, {"source": "synthetic", "input_shape": (seq_len,)}, vocab_size, "lm"
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def build_federated_data(cfg: DataConfig, seed: int = 0, **model_kwargs) -> FederatedData:
+    """Load a dataset and partition it into ``cfg.num_clients`` shards."""
+    loader = dataset_registry.get(cfg.name)
+    tx, ty, ex, ey, meta, num_classes, task = loader(cfg, **model_kwargs)
+    labels_for_partition = ty if task == "classify" else ty[:, 0]
+    client_indices = partition_lib.partition(
+        cfg.partition,
+        labels=labels_for_partition,
+        num_clients=cfg.num_clients,
+        num_classes=num_classes if task == "classify" else int(labels_for_partition.max()) + 1,
+        alpha=cfg.dirichlet_alpha,
+        seed=seed,
+        natural_groups=meta.get("natural_groups"),
+    )
+    meta = dict(meta, partition=cfg.partition)
+    return FederatedData(
+        train_x=tx, train_y=ty, test_x=ex, test_y=ey,
+        client_indices=client_indices, num_classes=num_classes, task=task, meta=meta,
+    )

@@ -1,0 +1,108 @@
+"""Round-batch construction (SURVEY.md §7 "static shapes vs heterogeneous clients").
+
+XLA traces one round program with fixed shapes; real clients have
+heterogeneous example counts. The resolution: every client-round is
+padded to the same ``[steps, batch]`` grid of example *indices* with a
+parallel validity mask, and the true example counts ride along for the
+FedAvg weighted sum. The index tensors are tiny (int32), generated on
+host with NumPy, and gathered **on device** against the HBM-resident
+example arrays — the host never moves example bytes during training.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from colearn_federated_learning_tpu.config import ClientConfig, DataConfig
+from colearn_federated_learning_tpu.data.core import FederatedData
+
+
+@dataclass(frozen=True)
+class RoundShape:
+    """Static shape of one client-round. Changing any field retraces XLA."""
+
+    local_epochs: int
+    steps_per_epoch: int
+    batch_size: int
+    cap: int  # max examples a client contributes per epoch
+
+    @property
+    def steps(self) -> int:
+        return self.local_epochs * self.steps_per_epoch
+
+
+def compute_round_shape(
+    fed: FederatedData, client_cfg: ClientConfig, data_cfg: DataConfig
+) -> RoundShape:
+    sizes = fed.client_sizes()
+    cap = data_cfg.max_examples_per_client or int(sizes.max())
+    cap = min(cap, int(sizes.max()))
+    steps_per_epoch = max(1, math.ceil(cap / client_cfg.batch_size))
+    return RoundShape(
+        local_epochs=client_cfg.local_epochs,
+        steps_per_epoch=steps_per_epoch,
+        batch_size=client_cfg.batch_size,
+        cap=cap,
+    )
+
+
+def make_round_indices(
+    fed: FederatedData,
+    cohort_ids: Sequence[int],
+    shape: RoundShape,
+    rng: np.random.Generator,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build (idx, mask, n_examples) for one round's cohort.
+
+    idx:        [K, steps, batch] int32 — gather indices into train_x/train_y
+                (padding positions point at index 0 and are masked out)
+    mask:       [K, steps, batch] float32 — 1.0 on real examples
+    n_examples: [K] float32 — real examples processed this round (the
+                FedAvg weight; proportional to |D_i| at equal epochs)
+    """
+    k = len(cohort_ids)
+    steps, batch = shape.steps, shape.batch_size
+    idx = np.zeros((k, steps * batch), np.int32)
+    mask = np.zeros((k, steps * batch), np.float32)
+    n_examples = np.zeros((k,), np.float32)
+    per_epoch = shape.steps_per_epoch * batch
+    for row, cid in enumerate(cohort_ids):
+        ids = fed.client_indices[cid]
+        if len(ids) > shape.cap:
+            ids = rng.choice(ids, size=shape.cap, replace=False)
+        n = len(ids)
+        for e in range(shape.local_epochs):
+            perm = rng.permutation(ids).astype(np.int32)
+            off = e * per_epoch
+            idx[row, off : off + n] = perm
+            mask[row, off : off + n] = 1.0
+        n_examples[row] = n * shape.local_epochs
+    return (
+        idx.reshape(k, steps, batch),
+        mask.reshape(k, steps, batch),
+        n_examples,
+    )
+
+
+def eval_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Pad the test set to a whole number of fixed-size batches.
+
+    Returns (x_batches [B, batch, ...], y_batches, mask [B, batch]) so the
+    jitted eval loop sees one static shape.
+    """
+    n = len(x)
+    n_batches = max(1, math.ceil(n / batch_size))
+    total = n_batches * batch_size
+    pad = total - n
+    xp = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
+    yp = np.concatenate([y, np.repeat(y[:1], pad, axis=0)]) if pad else y
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    return (
+        xp.reshape((n_batches, batch_size) + x.shape[1:]),
+        yp.reshape((n_batches, batch_size) + y.shape[1:]),
+        mask.reshape(n_batches, batch_size),
+    )

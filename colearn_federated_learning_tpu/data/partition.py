@@ -1,0 +1,116 @@
+"""Federated partitioners (SURVEY.md §2 C11).
+
+Capability parity (BASELINE.json:8-11): IID, Dirichlet(α) label-skew
+non-IID, LEAF natural per-writer splits, and cross-silo equal splits.
+All partitioners are pure NumPy on index arrays — they produce the
+federation *structure*; bytes stay in the flat example arrays.
+
+Invariants (pinned by tests, SURVEY.md §4.1):
+- the client shards partition the example index set (disjoint, complete,
+  up to the documented Dirichlet remainder handling);
+- Dirichlet: α→∞ approaches IID class mixtures, α→0 approaches
+  label-pure clients;
+- determinism: same seed ⇒ identical shards.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def iid_partition(n: int, num_clients: int, seed: int) -> List[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    return [np.sort(s) for s in np.array_split(perm, num_clients)]
+
+
+def dirichlet_partition(
+    labels: np.ndarray, num_clients: int, num_classes: int, alpha: float, seed: int,
+    min_size: int = 1,
+) -> List[np.ndarray]:
+    """Label-skew non-IID: for each class, split its examples across clients
+    by proportions drawn from Dirichlet(α)·𝟙. Standard FL recipe (Hsu et al.).
+
+    Re-draws until every client has ≥ ``min_size`` examples, which mirrors
+    the usual implementation and keeps downstream static shapes sane.
+    """
+    rng = np.random.default_rng(seed)
+    n = len(labels)
+    for _attempt in range(100):
+        shards: List[List[int]] = [[] for _ in range(num_clients)]
+        for c in range(num_classes):
+            idx_c = np.flatnonzero(labels == c)
+            rng.shuffle(idx_c)
+            props = rng.dirichlet(np.full(num_clients, alpha))
+            # cumulative split points over this class's examples
+            cuts = (np.cumsum(props)[:-1] * len(idx_c)).astype(int)
+            for shard, part in zip(shards, np.split(idx_c, cuts)):
+                shard.extend(part.tolist())
+        sizes = [len(s) for s in shards]
+        if min(sizes) >= min_size:
+            return [np.sort(np.array(s, np.int64)) for s in shards]
+    raise RuntimeError(
+        f"dirichlet_partition: could not satisfy min_size={min_size} with "
+        f"alpha={alpha}, n={n}, num_clients={num_clients}"
+    )
+
+
+def natural_partition(
+    groups: Sequence[np.ndarray], num_clients: int, seed: int
+) -> List[np.ndarray]:
+    """LEAF-style natural split: each group is one writer/character's
+    examples. If there are more groups than clients, groups are merged
+    round-robin by size (largest first) to balance; fewer groups than
+    clients is an error (natural splits can't be subdivided)."""
+    if len(groups) < num_clients:
+        raise ValueError(
+            f"natural_partition: {len(groups)} natural groups < {num_clients} clients"
+        )
+    order = np.argsort([-len(g) for g in groups])
+    rng = np.random.default_rng(seed)
+    assign = [[] for _ in range(num_clients)]
+    sizes = np.zeros(num_clients, np.int64)
+    for gi in order:
+        # place largest remaining group on the currently smallest client
+        tgt = int(np.argmin(sizes))
+        assign[tgt].append(gi)
+        sizes[tgt] += len(groups[gi])
+    del rng  # reserved for future randomized tie-breaking
+    return [
+        np.sort(np.concatenate([np.asarray(groups[gi], np.int64) for gi in gis]))
+        for gis in assign
+    ]
+
+
+def silo_partition(n: int, num_clients: int, seed: int) -> List[np.ndarray]:
+    """Cross-silo: equal random split (silos are institutions with big,
+    roughly-IID shards — BASELINE.json:11's 32-silo ImageNet config)."""
+    return iid_partition(n, num_clients, seed)
+
+
+def partition(
+    kind: str,
+    labels: np.ndarray,
+    num_clients: int,
+    num_classes: int,
+    alpha: float,
+    seed: int,
+    natural_groups: Optional[Sequence[np.ndarray]] = None,
+) -> List[np.ndarray]:
+    n = len(labels)
+    if kind == "iid":
+        return iid_partition(n, num_clients, seed)
+    if kind == "dirichlet":
+        return dirichlet_partition(labels, num_clients, num_classes, alpha, seed)
+    if kind == "natural":
+        if natural_groups is None:
+            # Synthetic stand-in for a LEAF natural split: heavy label skew +
+            # heterogeneous sizes, which is what "natural" delivers in practice.
+            return dirichlet_partition(labels, num_clients, num_classes,
+                                       alpha=0.3, seed=seed)
+        return natural_partition(natural_groups, num_clients, seed)
+    if kind == "silo":
+        return silo_partition(n, num_clients, seed)
+    raise ValueError(f"unknown partition kind {kind!r}")

@@ -1,0 +1,51 @@
+"""Model zoo (SURVEY.md §2 C9, layer L0a).
+
+Capability parity targets (BASELINE.json:7-11): LeNet-5, ResNet-18,
+MobileNetV2, BERT-tiny (causal LM), ViT-B/16. All are ``flax.linen``
+modules with pure-pytree params so FedAvg's weighted-sum is plain tree
+arithmetic, and all use static shapes + GroupNorm-style normalization
+(no batch statistics crossing client boundaries — BatchNorm is both bad
+FL practice and a running-stats headache for functional aggregation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.utils.registry import Registry
+
+model_registry = Registry("model")
+
+
+def build_model(name: str, num_classes: int, **kwargs):
+    """Instantiate a model module from the zoo."""
+    return model_registry.get(name)(num_classes=num_classes, **kwargs)
+
+
+def model_input_spec(name: str, **kwargs) -> Tuple[Tuple[int, ...], Any]:
+    """(example input shape without batch dim, dtype) for a model family."""
+    spec = _INPUT_SPECS[name]
+    if callable(spec):
+        return spec(**kwargs)
+    return spec
+
+
+def init_params(model, input_shape, seed: int = 0, input_dtype=jnp.float32):
+    """Initialize a params pytree with a dummy batch of one."""
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1,) + tuple(input_shape), input_dtype)
+    variables = model.init(rng, dummy, train=False)
+    return variables["params"]
+
+
+# populated by the module imports below
+_INPUT_SPECS = {}
+
+from colearn_federated_learning_tpu.models import lenet  # noqa: E402,F401
+from colearn_federated_learning_tpu.models import resnet  # noqa: E402,F401
+from colearn_federated_learning_tpu.models import mobilenet  # noqa: E402,F401
+from colearn_federated_learning_tpu.models import bert  # noqa: E402,F401
+from colearn_federated_learning_tpu.models import vit  # noqa: E402,F401

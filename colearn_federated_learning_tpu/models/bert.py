@@ -1,0 +1,79 @@
+"""BERT-tiny-scale transformer used as a causal next-token LM
+(BASELINE config #4, BASELINE.json:10 — "BERT-tiny next-token on Shakespeare").
+
+BERT-tiny geometry (L=2, H=128, A=2, FF=512) with a causal mask, learned
+positional embeddings, and weight-tied output head. LEAF Shakespeare is
+char-level (~90 symbols, 80-char crops) so sequences are tiny; attention
+is plain full attention on one chip (SURVEY.md §5 records ring/sequence
+parallelism as a non-goal at this scale). The attention entry point is
+factored into ``ops.attention`` so a pallas/ring kernel can slot in for
+long-sequence configs without touching the model.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+from colearn_federated_learning_tpu.ops.attention import causal_attention
+
+
+class TransformerBlock(nn.Module):
+    hidden: int
+    heads: int
+    ff: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        qkv = nn.Dense(3 * self.hidden, dtype=self.compute_dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = causal_attention(q, k, v, self.heads)
+        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(att)
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        h = nn.Dense(self.ff, dtype=self.compute_dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(h)
+        return x
+
+
+class BertTinyLM(nn.Module):
+    vocab_size: int = 90
+    seq_len: int = 80
+    hidden: int = 128
+    heads: int = 2
+    layers: int = 2
+    ff: int = 512
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        # tokens: [B, T] int32 → logits [B, T, V] (next-token prediction)
+        embed = nn.Embed(self.vocab_size, self.hidden,
+                         embedding_init=nn.initializers.normal(0.02))
+        x = embed(tokens).astype(self.compute_dtype)
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (self.seq_len, self.hidden))
+        x = x + pos[None, : x.shape[1], :].astype(self.compute_dtype)
+        for _ in range(self.layers):
+            x = TransformerBlock(self.hidden, self.heads, self.ff, self.compute_dtype)(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        # weight-tied head
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+@model_registry.register("bert_tiny")
+def _build(num_classes: int = 0, vocab_size: int = 90, seq_len: int = 80,
+           compute_dtype=jnp.float32, **_):
+    del num_classes  # LM: output dim == vocab_size
+    return BertTinyLM(vocab_size=vocab_size, seq_len=seq_len, compute_dtype=compute_dtype)
+
+
+def _lm_spec(vocab_size: int = 90, seq_len: int = 80, **_):
+    return (seq_len,), jnp.int32
+
+
+_INPUT_SPECS["bert_tiny"] = _lm_spec

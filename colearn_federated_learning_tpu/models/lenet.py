@@ -1,0 +1,40 @@
+"""LeNet-5 for MNIST (BASELINE config #1, BASELINE.json:7).
+
+Classic 2-conv/3-fc LeNet. NHWC layout (TPU-native); average pooling as
+in the original. ~61k params — the CPU-smoke model.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.tanh(nn.Dense(120, dtype=self.compute_dtype)(x))
+        x = nn.tanh(nn.Dense(84, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+@model_registry.register("lenet5")
+def _build(num_classes: int = 10, compute_dtype=jnp.float32, **_):
+    return LeNet5(num_classes=num_classes, compute_dtype=compute_dtype)
+
+
+_INPUT_SPECS["lenet5"] = ((28, 28, 1), jnp.float32)

@@ -1,0 +1,104 @@
+"""MobileNetV2 for FEMNIST (BASELINE config #3, BASELINE.json:9).
+
+Inverted-residual bottlenecks with GroupNorm (same FL/functional
+rationale as resnet.py). FEMNIST is 28×28 grayscale with 62 classes; the
+stem stride adapts to small inputs so the net doesn't collapse spatial
+dims to zero.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _gn(ch: int, dtype):
+    # group count must divide channels; channels here are multiples of 8
+    return nn.GroupNorm(num_groups=min(8, ch), dtype=dtype)
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    strides: int
+    expand: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = conv(hidden, (1, 1))(y)
+            y = nn.relu6(_gn(hidden, self.compute_dtype)(y))
+        # depthwise
+        y = conv(hidden, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME", feature_group_count=hidden)(y)
+        y = nn.relu6(_gn(hidden, self.compute_dtype)(y))
+        y = conv(self.filters, (1, 1))(y)
+        y = _gn(self.filters, self.compute_dtype)(y)
+        if self.strides == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 62
+    width_mult: float = 1.0
+    small_inputs: bool = True
+    compute_dtype: jnp.dtype = jnp.float32
+    # (expand, filters, repeats, stride)
+    blocks: Sequence[Tuple[int, int, int, int]] = (
+        (1, 16, 1, 1),
+        (6, 24, 2, 2),
+        (6, 32, 3, 2),
+        (6, 64, 4, 2),
+        (6, 96, 3, 1),
+        (6, 160, 3, 2),
+        (6, 320, 1, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        stem_stride = 1 if self.small_inputs else 2
+        ch = _make_divisible(32 * self.width_mult)
+        x = nn.Conv(ch, (3, 3), strides=(stem_stride, stem_stride), padding="SAME",
+                    use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.relu6(_gn(ch, self.compute_dtype)(x))
+        for i, (t, c, n, s) in enumerate(self.blocks):
+            filters = _make_divisible(c * self.width_mult)
+            for b in range(n):
+                stride = s if b == 0 else 1
+                # avoid over-striding 28×28 inputs: drop the last two downsamples
+                if self.small_inputs and i >= 5:
+                    stride = 1
+                x = InvertedResidual(filters, stride, t, self.compute_dtype)(x)
+        head = _make_divisible(1280 * max(1.0, self.width_mult))
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.relu6(_gn(head, self.compute_dtype)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@model_registry.register("mobilenetv2")
+def _build(num_classes: int = 62, width_mult: float = 1.0, small_inputs: bool = True,
+           compute_dtype=jnp.float32, **_):
+    return MobileNetV2(num_classes=num_classes, width_mult=width_mult,
+                       small_inputs=small_inputs, compute_dtype=compute_dtype)
+
+
+_INPUT_SPECS["mobilenetv2"] = ((28, 28, 1), jnp.float32)

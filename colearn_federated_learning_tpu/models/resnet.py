@@ -1,0 +1,78 @@
+"""ResNet-18 for CIFAR-10 (BASELINE config #2, BASELINE.json:8).
+
+TPU/FL-first choices:
+- **GroupNorm instead of BatchNorm** — batch statistics are per-client
+  state that poisons FedAvg's weighted parameter mean and forces mutable
+  collections through the functional round engine; GroupNorm is the
+  standard FL substitute (SURVEY.md §7 "hard parts") and keeps params a
+  pure pytree.
+- CIFAR stem (3×3 conv, no maxpool) when ``small_inputs=True`` — the
+  standard ResNet-18 adaptation for 32×32 inputs; the ImageNet stem
+  (7×7/2 + maxpool) is kept for 224×224.
+- NHWC layout and bfloat16-friendly compute dtype for the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        norm = partial(nn.GroupNorm, num_groups=min(32, self.filters), dtype=self.compute_dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    small_inputs: bool = True
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False, dtype=self.compute_dtype)(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=32, dtype=self.compute_dtype)(x))
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2**i)
+            for b in range(n_blocks):
+                strides = 2 if (i > 0 and b == 0) else 1
+                x = ResNetBlock(filters, strides, self.compute_dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@model_registry.register("resnet18")
+def _build(num_classes: int = 10, small_inputs: bool = True, compute_dtype=jnp.float32, **_):
+    return ResNet18(num_classes=num_classes, small_inputs=small_inputs, compute_dtype=compute_dtype)
+
+
+_INPUT_SPECS["resnet18"] = ((32, 32, 3), jnp.float32)

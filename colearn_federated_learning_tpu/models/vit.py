@@ -1,0 +1,75 @@
+"""ViT-B/16 for federated ImageNet (BASELINE config #5, BASELINE.json:11).
+
+Standard ViT-Base/16: 12 layers, hidden 768, 12 heads, MLP 3072, CLS
+token, learned positional embeddings. Patchify is a strided Conv (maps
+straight onto the MXU). LayerNorm params are pure pytree leaves, so the
+cross-silo FedAvg/DP path aggregates everything uniformly.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+from colearn_federated_learning_tpu.ops.attention import full_attention
+
+
+class ViTBlock(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        qkv = nn.Dense(3 * self.hidden, dtype=self.compute_dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = full_attention(q, k, v, self.heads)
+        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(att)
+        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        h = nn.gelu(nn.Dense(self.mlp_dim, dtype=self.compute_dtype)(h))
+        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(h)
+        return x
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    image_size: int = 224
+    patch_size: int = 16
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(self.hidden, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID", dtype=self.compute_dtype)(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.hidden))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden)).astype(x.dtype), x], axis=1)
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.hidden))
+        x = x + pos.astype(x.dtype)
+        for _ in range(self.layers):
+            x = ViTBlock(self.hidden, self.heads, self.mlp_dim, self.compute_dtype)(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+
+
+@model_registry.register("vit_b16")
+def _build(num_classes: int = 1000, image_size: int = 224, compute_dtype=jnp.float32, **_):
+    return ViT(num_classes=num_classes, image_size=image_size, compute_dtype=compute_dtype)
+
+
+def _vit_spec(image_size: int = 224, **_):
+    return (image_size, image_size, 3), jnp.float32
+
+
+_INPUT_SPECS["vit_b16"] = _vit_spec

@@ -1,0 +1,1 @@
+"""Hot-path ops: attention entry points and (later rounds) pallas kernels."""

@@ -1,0 +1,46 @@
+"""Attention ops.
+
+``causal_attention`` is the single entry point every transformer in the
+zoo calls, so swapping in a fused pallas kernel or a ring/sequence-
+parallel variant for long-context configs is a one-site change. The
+default is plain XLA attention — at BERT-tiny/ViT scale XLA's fusion is
+already near-roofline, and SURVEY.md §5 records long-context sequence
+parallelism as out of scope for the reference's capability surface.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.nn import softmax
+
+
+def _split_heads(x, heads: int):
+    b, t, d = x.shape
+    return x.reshape(b, t, heads, d // heads).transpose(0, 2, 1, 3)  # [B,H,T,hd]
+
+
+def _merge_heads(x):
+    b, h, t, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+
+
+def multihead_attention(q, k, v, heads: int, mask=None):
+    """[B,T,D] q/k/v → [B,T,D]; mask broadcastable to [B,H,T,T] (True=keep)."""
+    q, k, v = _split_heads(q, heads), _split_heads(k, heads), _split_heads(v, heads)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    att = softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    return _merge_heads(out)
+
+
+def causal_attention(q, k, v, heads: int):
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))[None, None, :, :]
+    return multihead_attention(q, k, v, heads, mask)
+
+
+def full_attention(q, k, v, heads: int):
+    return multihead_attention(q, k, v, heads, None)

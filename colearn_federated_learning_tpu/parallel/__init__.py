@@ -1,0 +1,9 @@
+"""Parallelism layer: device mesh + the shard_map/psum round engine (L0b).
+
+The reference's distributed backend is torch.distributed + NCCL
+(BASELINE.json:5). The TPU-native equivalent is not a socket library —
+it is a ``jax.sharding.Mesh`` whose ``"clients"`` axis spans all chips,
+with XLA collectives (``psum``) riding the ICI. Multi-host extension is
+``jax.distributed.initialize`` + the same mesh over more processes; no
+code in the round engine changes.
+"""

@@ -1,0 +1,49 @@
+"""Device-mesh construction for client-parallel FL simulation.
+
+One chip == one virtual-client lane (the north star, BASELINE.json:5).
+With cohort_size K and L lanes, each lane trains K/L clients
+sequentially per round under ``lax.scan``; the weighted aggregation is a
+``psum`` over the ``"clients"`` mesh axis.
+
+All code is size-agnostic (SURVEY.md §7 "hard parts"): the same mesh
+builds over 1 real TPU chip, 8 fake CPU devices, or a v4-32 pod slice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+
+def largest_lane_count(cohort_size: int, n_devices: int) -> int:
+    """Largest divisor of cohort_size that is ≤ n_devices.
+
+    The cohort must split evenly over lanes (static shapes); an 11-client
+    cohort on 8 chips runs on 1 lane rather than silently padding.
+    """
+    for lanes in range(min(cohort_size, n_devices), 0, -1):
+        if cohort_size % lanes == 0:
+            return lanes
+    return 1
+
+
+def build_client_mesh(num_lanes: int = 0, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if num_lanes <= 0:
+        num_lanes = len(devices)
+    if num_lanes > len(devices):
+        raise ValueError(f"num_lanes {num_lanes} > visible devices {len(devices)}")
+    return Mesh(np.array(devices[:num_lanes]), (CLIENT_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def client_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard leading (cohort) axis across lanes."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))

@@ -1,0 +1,142 @@
+"""The TPU-native FL round engine (SURVEY.md §2 C8, §3.1; the north star).
+
+One federated round == ONE compiled XLA program::
+
+    jit(
+      shard_map over Mesh(("clients",)):
+        lane: lax.scan over its cohort chunk:
+                 client local training (lax.scan over steps)
+              → Σ nᵢ·Δᵢ, Σ nᵢ, Σ nᵢ·lossᵢ   (per-lane partial sums)
+        psum over "clients"                  (the NCCL-allreduce analogue)
+      → server optimizer applies Δ̄
+    )
+
+What the reference does with a process group + NCCL allreduce
+(BASELINE.json:5) is here a single ``jax.lax.psum`` riding the ICI; the
+params broadcast disappears entirely because the psum result is already
+replicated. Host involvement per round: feeding the int32 index/mask
+tensors and one ``device_get`` of scalar metrics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from colearn_federated_learning_tpu.client.trainer import make_local_train_fn
+from colearn_federated_learning_tpu.parallel.mesh import CLIENT_AXIS
+from colearn_federated_learning_tpu.utils import trees
+
+
+def _pcast_varying(tree):
+    def cast(x):
+        if CLIENT_AXIS in getattr(jax.typeof(x), "vma", frozenset()):
+            return x  # already device-varying
+        return jax.lax.pcast(x, (CLIENT_AXIS,), to="varying")
+
+    return jax.tree.map(cast, tree)
+
+
+class RoundMetrics(NamedTuple):
+    train_loss: jnp.ndarray  # cohort example-weighted mean local loss
+    examples: jnp.ndarray  # total real examples processed
+
+
+def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
+                          cohort_size: int, donate: bool = True):
+    """Build the jitted one-program round function.
+
+    Signature of the returned fn::
+
+        (params, server_opt_state, train_x, train_y,
+         idx [K,steps,batch], mask [K,steps,batch], n_ex [K], rng)
+        → (new_params, new_server_opt_state, RoundMetrics)
+
+    ``n_ex`` are the FedAvg weights; simulated client dropout
+    (SURVEY.md §5) is upstream zeroing of entries — exact math, no
+    control-flow divergence.
+    """
+    local_train = make_local_train_fn(model, client_cfg, dp_cfg, task)
+    n_lanes = mesh.shape[CLIENT_AXIS]
+    if cohort_size % n_lanes != 0:
+        raise ValueError(f"cohort {cohort_size} not divisible by lanes {n_lanes}")
+
+    def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys):
+        # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
+        # Mark params as device-varying so scan carries (which mix in
+        # per-lane data) type-check under shard_map's vma system.
+        params = _pcast_varying(params)
+        def per_client(acc, inp):
+            c_idx, c_mask, c_n, c_key = inp
+            w_i, m_i = local_train(params, train_x, train_y, c_idx, c_mask, c_key)
+            delta = trees.tree_sub(w_i, params)
+            d_acc, n_acc, l_acc = acc
+            d_acc = trees.tree_axpy(c_n, delta, d_acc)
+            return (d_acc, n_acc + c_n, l_acc + c_n * m_i.loss), None
+
+        acc0 = _pcast_varying(
+            (trees.tree_zeros_like(params), jnp.zeros(()), jnp.zeros(()))
+        )
+        (d_sum, n_sum, l_sum), _ = jax.lax.scan(
+            per_client, acc0, (idx, mask, n_ex, keys)
+        )
+        # The aggregation collective — the reference's NCCL allreduce
+        # (BASELINE.json:5) as a single XLA psum over the ICI.
+        d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
+        n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
+        l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
+        denom = jnp.maximum(n_sum, 1.0)
+        mean_delta = trees.tree_scale(d_sum, 1.0 / denom)
+        return mean_delta, n_sum, l_sum / denom
+
+    sharded_lane = jax.shard_map(
+        lane_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
+        keys = jax.random.split(rng, idx.shape[0])
+        mean_delta, n_total, mean_loss = sharded_lane(
+            params, train_x, train_y, idx, mask, n_ex, keys
+        )
+        new_params, new_opt_state = server_update(params, server_opt_state, mean_delta)
+        return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
+
+    return round_fn
+
+
+def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update):
+    """Reference-semantics engine: python loop over the cohort, jitted
+    per-client local training, host-side weighted mean. Used for
+    single-device debugging and as the parity oracle the shard_map
+    engine is tested against (SURVEY.md §4.3)."""
+    local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task))
+    update = jax.jit(server_update)
+
+    def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
+        k = idx.shape[0]
+        keys = jax.random.split(rng, k)
+        deltas, weights, losses = [], [], []
+        for c in range(k):
+            w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c], keys[c])
+            deltas.append(trees.tree_sub(w_i, params))
+            weights.append(n_ex[c])
+            losses.append(m_i.loss)
+        n_total = jnp.sum(jnp.stack([jnp.asarray(w) for w in weights]))
+        denom = jnp.maximum(n_total, 1.0)
+        acc = trees.tree_zeros_like(params)
+        for d, w in zip(deltas, weights):
+            acc = trees.tree_axpy(w, d, acc)
+        mean_delta = trees.tree_scale(acc, 1.0 / denom)
+        mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
+        new_params, new_opt_state = update(params, server_opt_state, mean_delta)
+        return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
+
+    return round_fn

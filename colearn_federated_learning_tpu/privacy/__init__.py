@@ -1,0 +1,1 @@
+"""Differential privacy: DP-SGD gradients and the (ε, δ) accountant."""

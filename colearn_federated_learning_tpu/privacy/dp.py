@@ -1,0 +1,128 @@
+"""DP-SGD on TPU (SURVEY.md §2 C12; BASELINE config #5).
+
+Per-example gradient clipping + Gaussian noise, Abadi et al. 2016. The
+TPU-shaped part (SURVEY.md §7 "hard parts"): per-example grads via
+``jax.vmap(jax.grad)`` are memory-heavy, so the batch is processed as a
+``lax.scan`` over microbatches of vmapped per-example grads — peak
+memory is ``microbatch_size`` gradient pytrees, compute stays batched
+enough to keep the MXU busy.
+
+Padding interaction: padded examples (mask 0) get their clip scale
+forced to 0, so they contribute nothing; the mean divides by the real
+example count and noise is scaled to clip/denominator as usual.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.config import DPConfig
+from colearn_federated_learning_tpu.utils import trees
+
+
+def make_dp_grad_fn(loss_fn, cfg: DPConfig):
+    """Wrap a masked-mean loss into a DP-SGD gradient estimator.
+
+    loss_fn(params, x, y, m) must be a mean over the mask — internally we
+    re-call it per example with a singleton mask so the per-example
+    gradient is the plain example gradient.
+    """
+
+    def single_example_grad(params, x1, y1):
+        one = jnp.ones((1,), jnp.float32)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, x1[None], y1[None], one
+        )
+        return loss, grads
+
+    def dp_grads(params, x, y, m, rng):
+        b = x.shape[0]
+        mb = max(1, min(cfg.microbatch_size, b))
+        n_micro = b // mb
+        assert n_micro * mb == b, (
+            f"batch {b} not divisible by microbatch {mb}"
+        )
+        xm = x.reshape((n_micro, mb) + x.shape[1:])
+        ym = y.reshape((n_micro, mb) + y.shape[1:])
+        mm = m.reshape(n_micro, mb)
+
+        def micro_step(acc, inp):
+            xs, ys, ms = inp
+            losses, grads = jax.vmap(single_example_grad, in_axes=(None, 0, 0))(
+                params, xs, ys
+            )  # grads: pytree with leading [mb]
+            norms = jnp.sqrt(
+                sum(
+                    jnp.sum(jnp.square(g.reshape(mb, -1)), axis=1)
+                    for g in jax.tree.leaves(grads)
+                )
+            )
+            scale = jnp.minimum(1.0, cfg.l2_clip / jnp.maximum(norms, 1e-12)) * ms
+            clipped_sum = jax.tree.map(
+                lambda g: jnp.einsum("b,b...->...", scale, g), grads
+            )
+            acc_g, acc_loss = acc
+            return (trees.tree_add(acc_g, clipped_sum), acc_loss + (losses * ms).sum()), None
+
+        # Initial accumulators derive their sharding type from the data
+        # (0·Σm), so the scan carry type-checks identically inside a
+        # shard_map lane (device-varying) and in plain jit.
+        zero_scalar = 0.0 * m.sum()
+        zero = jax.tree.map(lambda p: jnp.zeros_like(p) + zero_scalar.astype(p.dtype), params)
+        (g_sum, loss_sum), _ = jax.lax.scan(
+            micro_step, (zero, zero_scalar), (xm, ym, mm)
+        )
+        denom = jnp.maximum(m.sum(), 1.0)
+        keys = jax.random.split(rng, len(jax.tree.leaves(params)))
+        keys = jax.tree.unflatten(jax.tree.structure(params), list(keys))
+        sigma = cfg.noise_multiplier * cfg.l2_clip
+        noisy = jax.tree.map(
+            lambda g, k: (g + sigma * jax.random.normal(k, g.shape, g.dtype)) / denom,
+            g_sum,
+            keys,
+        )
+        return loss_sum / denom, noisy
+
+    return dp_grads
+
+
+def rdp_epsilon(
+    noise_multiplier: float,
+    sampling_rate: float,
+    steps: int,
+    delta: float,
+    orders=tuple([1.25, 1.5, 1.75, 2.0, 2.5, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0,
+                  12.0, 16.0, 20.0, 24.0, 32.0, 48.0, 64.0]),
+) -> float:
+    """Moments/RDP accountant for the subsampled Gaussian mechanism.
+
+    Per-order RDP bound, composed over ``steps`` and converted to (ε, δ):
+
+    - amplified bound ``RDP(α) ≤ q²·α/σ²`` (Abadi et al. moments bound)
+      only where it is valid — ``α ≤ σ²·log(1/(q·σ))`` and ``σ ≥ 1`` —
+    - otherwise the always-valid unamplified Gaussian bound
+      ``RDP(α) = α/(2σ²)`` (subsampling can only help, never hurt).
+
+    Conservative but sound for reporting; a tighter accountant can swap
+    in later without touching callers.
+    """
+    import math
+
+    if noise_multiplier <= 0:
+        return float("inf")
+    q = min(1.0, sampling_rate)
+    sigma = noise_multiplier
+    if q * sigma < 1.0 and sigma >= 1.0:
+        alpha_max = sigma * sigma * math.log(1.0 / (q * sigma))
+    else:
+        alpha_max = 0.0  # amplified bound never valid
+    best = float("inf")
+    for alpha in orders:
+        if alpha <= alpha_max:
+            rdp_per_step = (q * q * alpha) / (sigma * sigma)
+        else:
+            rdp_per_step = alpha / (2.0 * sigma * sigma)
+        eps = steps * rdp_per_step + math.log(1.0 / delta) / (alpha - 1.0)
+        best = min(best, eps)
+    return best

@@ -1,0 +1,1 @@
+"""Server side: cohort sampling, aggregation, round driver (layers L3/L4)."""

@@ -1,0 +1,58 @@
+"""Aggregation + server optimizer (SURVEY.md §2 C6; call stack §3.4).
+
+The math: ``Δ̄ = Σᵢ nᵢ·Δᵢ / Σᵢ nᵢ`` over the cohort (the reference
+realizes the same weighted-sum as an NCCL allreduce, BASELINE.json:5;
+the shard_map engine realizes it as ``jax.lax.psum`` — see
+parallel/round_engine.py — and this module is the shared host-side /
+server-update half).
+
+We aggregate **deltas** (wᵢ − w_global) rather than raw params so a
+server-side optimizer (FedAvgM / FedAdam, Reddi et al. 2021) can treat
+−Δ̄ as a pseudo-gradient. With the default ``mean`` optimizer and
+server_lr=1 this is exactly classic FedAvg.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from colearn_federated_learning_tpu.config import ServerConfig
+from colearn_federated_learning_tpu.utils import trees
+
+
+def weighted_delta_mean(deltas, weights):
+    """Host-side reference weighted mean over a list of delta pytrees."""
+    return trees.tree_weighted_mean(deltas, weights)
+
+
+def make_server_optimizer(cfg: ServerConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "mean":
+        return optax.sgd(cfg.server_lr)
+    if cfg.optimizer == "fedavgm":
+        return optax.sgd(cfg.server_lr, momentum=cfg.server_momentum)
+    if cfg.optimizer == "fedadam":
+        return optax.adam(cfg.server_lr, eps=1e-3)
+    raise ValueError(f"unknown server optimizer {cfg.optimizer!r}")
+
+
+def make_server_update_fn(cfg: ServerConfig):
+    """(params, opt_state, mean_delta) → (new_params, new_opt_state).
+
+    Feeds ``−Δ̄`` to optax as the gradient, so every optax transform is a
+    valid server optimizer.
+    """
+    opt = make_server_optimizer(cfg)
+
+    def init(params) -> Any:
+        return opt.init(params)
+
+    def update(params, opt_state, mean_delta) -> Tuple[Any, Any]:
+        pseudo_grad = jax.tree.map(jnp.negative, mean_delta)
+        updates, opt_state = opt.update(pseudo_grad, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    return init, update

@@ -1,0 +1,254 @@
+"""Server round driver (SURVEY.md §2 C3, call stack §3.1; layer L4).
+
+Owns the outer round loop the reference drives from its server process:
+sample cohort → (broadcast) → local training → aggregate → eval / log /
+checkpoint. In the sharded engine the broadcast+train+aggregate middle
+is one XLA program (parallel/round_engine.py); this driver's per-round
+host work is just index-tensor construction and a scalar metrics fetch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.client.trainer import make_eval_fn
+from colearn_federated_learning_tpu.config import ExperimentConfig
+from colearn_federated_learning_tpu.data import build_federated_data
+from colearn_federated_learning_tpu.data.loader import (
+    compute_round_shape,
+    eval_batches,
+    make_round_indices,
+)
+from colearn_federated_learning_tpu.models import build_model
+from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+from colearn_federated_learning_tpu.server.sampler import CohortSampler
+from colearn_federated_learning_tpu.utils.checkpoint import CheckpointStore
+from colearn_federated_learning_tpu.utils.metrics import MetricsLogger, Throughput
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class Experiment:
+    """Everything needed to run ``fit`` / ``evaluate`` for one config."""
+
+    def __init__(self, cfg: ExperimentConfig, echo: bool = True):
+        cfg.validate()
+        self.cfg = cfg
+        if cfg.run.sanitize:
+            jax.config.update("jax_debug_nans", True)
+        compute_dtype = _DTYPES[cfg.run.compute_dtype]
+        self.model = build_model(
+            cfg.model.name, cfg.model.num_classes,
+            compute_dtype=compute_dtype, **cfg.model.kwargs
+        )
+        self.fed = build_federated_data(cfg.data, seed=cfg.run.seed, **cfg.model.kwargs)
+        self.task = self.fed.task
+        self.shape = compute_round_shape(self.fed, cfg.client, cfg.data)
+        self.sampler = CohortSampler(
+            self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed
+        )
+        self.server_opt_init, server_update = make_server_update_fn(cfg.server)
+
+        if cfg.run.engine == "sharded":
+            if cfg.run.num_lanes:
+                lanes = cfg.run.num_lanes
+                if cfg.server.cohort_size % lanes != 0:
+                    raise ValueError(
+                        f"run.num_lanes={lanes} must divide cohort_size="
+                        f"{cfg.server.cohort_size} (set num_lanes=0 to auto-pick)"
+                    )
+            else:
+                lanes = mesh_lib.largest_lane_count(
+                    cfg.server.cohort_size, len(jax.devices())
+                )
+            self.mesh = mesh_lib.build_client_mesh(lanes)
+            self.round_fn = make_sharded_round_fn(
+                self.model, cfg.client, cfg.dp, self.task, self.mesh,
+                server_update, cfg.server.cohort_size,
+            )
+            self._data_sharding = mesh_lib.replicated(self.mesh)
+            self._cohort_sharding = mesh_lib.client_sharded(self.mesh)
+            self.n_chips = lanes
+        else:
+            self.mesh = None
+            self.round_fn = make_sequential_round_fn(
+                self.model, cfg.client, cfg.dp, self.task, server_update
+            )
+            self._data_sharding = None
+            self._cohort_sharding = None
+            self.n_chips = 1
+
+        # dataset bytes go to HBM exactly once (replicated over lanes)
+        put = (lambda a: jax.device_put(a, self._data_sharding)) if self._data_sharding else jax.device_put
+        self.train_x = put(jnp.asarray(self.fed.train_x))
+        self.train_y = put(jnp.asarray(self.fed.train_y))
+        self._eval_fn = jax.jit(make_eval_fn(self.model, self.task))
+        # eval batches are fixed for the run: build + upload exactly once
+        xb, yb, mb = eval_batches(
+            self.fed.test_x, self.fed.test_y, cfg.client.batch_size
+        )
+        self._eval_data = (put(jnp.asarray(xb)), put(jnp.asarray(yb)), put(jnp.asarray(mb)))
+        self.logger = MetricsLogger(cfg.run.out_dir or None, cfg.name, echo=echo,
+                                    append=cfg.run.resume)
+
+    # ------------------------------------------------------------------
+
+    def init_state(self, seed: Optional[int] = None) -> Dict[str, Any]:
+        seed = self.cfg.run.seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
+        init_rng, run_rng = jax.random.split(rng)
+        dummy = jnp.asarray(self.fed.train_x[:1])
+        variables = self.model.init(init_rng, dummy, train=False)
+        params = variables["params"]
+        return {
+            "params": params,
+            "server_opt_state": self.server_opt_init(params),
+            "round": 0,
+            "rng_key": run_rng,
+        }
+
+    def _place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Replicate params/opt state over the mesh (fresh init or restore)."""
+        if self._data_sharding is not None:
+            state["params"] = jax.device_put(state["params"], self._data_sharding)
+            state["server_opt_state"] = jax.device_put(
+                state["server_opt_state"], self._data_sharding
+            )
+        return state
+
+    def _round_inputs(self, round_idx: int):
+        cohort = self.sampler.sample(round_idx)
+        host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
+        idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+        if self.cfg.server.dropout_rate > 0:
+            # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
+            participate = (
+                host_rng.random(len(cohort)) >= self.cfg.server.dropout_rate
+            )
+            if not participate.any():
+                participate[host_rng.integers(len(cohort))] = True
+            n_ex = n_ex * participate.astype(np.float32)
+        if self._cohort_sharding is not None:
+            idx = jax.device_put(idx, self._cohort_sharding)
+            mask = jax.device_put(mask, self._cohort_sharding)
+            n_ex = jax.device_put(n_ex, self._cohort_sharding)
+        return cohort, idx, mask, n_ex
+
+    def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
+        cohort, idx, mask, n_ex = self._round_inputs(round_idx)
+        rng = jax.random.fold_in(state["rng_key"], round_idx)
+        params, opt_state, metrics = self.round_fn(
+            state["params"], state["server_opt_state"],
+            self.train_x, self.train_y, idx, mask, n_ex, rng,
+        )
+        return {
+            "params": params,
+            "server_opt_state": opt_state,
+            "round": round_idx + 1,
+            "rng_key": state["rng_key"],
+            "_metrics": metrics,
+        }
+
+    # ------------------------------------------------------------------
+
+    def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        cfg = self.cfg
+        store = None
+        if cfg.run.out_dir:
+            store = CheckpointStore(f"{cfg.run.out_dir}/{cfg.name}/ckpt")
+        if state is None:
+            if cfg.run.resume and store and store.latest_step() is not None:
+                template = self.init_state()
+                state, step = store.restore(template=template)
+                self.logger.log({"event": "resumed", "round": int(state["round"])})
+            else:
+                state = self.init_state()
+        state = self._place_state(state)
+        thr = Throughput(self.n_chips)
+        start_round = int(state["round"])
+        t_start = time.perf_counter()
+        for r in range(start_round, cfg.server.num_rounds):
+            profiling = r == cfg.run.profile_round
+            if profiling:
+                jax.profiler.start_trace(f"{cfg.run.out_dir}/{cfg.name}/profile")
+            state = self.run_round(state, r)
+            metrics = state.pop("_metrics")
+            if profiling:
+                jax.tree.map(lambda x: x.block_until_ready(), state["params"])
+                jax.profiler.stop_trace()
+            thr.mark(cfg.server.cohort_size)
+            record = {
+                "round": r + 1,
+                "train_loss": float(metrics.train_loss),
+                "examples": float(metrics.examples),
+                **{k: round(v, 4) for k, v in thr.rates().items()},
+            }
+            if cfg.run.sanitize:
+                finite = all(
+                    bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(state["params"])
+                )
+                if not finite:
+                    raise FloatingPointError(f"non-finite params after round {r + 1}")
+            if cfg.dp.enabled:
+                record["dp_epsilon"] = round(self.dp_epsilon(r + 1), 4)
+            if cfg.server.eval_every and (r + 1) % cfg.server.eval_every == 0:
+                record.update(self.evaluate(state["params"]))
+            self.logger.log(record)
+            if store and cfg.server.checkpoint_every and (r + 1) % cfg.server.checkpoint_every == 0:
+                store.save(r + 1, state)
+        state["wall_time"] = time.perf_counter() - t_start
+        if store:
+            if store.latest_step() != int(state["round"]):
+                store.save(int(state["round"]),
+                           {k: v for k, v in state.items() if k != "wall_time"},
+                           force=True)
+            store.close()
+        return state
+
+    # ------------------------------------------------------------------
+
+    def dp_epsilon(self, rounds_done: int) -> float:
+        """(ε, δ) spent so far: example-level DP-SGD accounting with
+        sampling rate = batch / avg participating-client shard size,
+        composed over every local step executed across rounds."""
+        from colearn_federated_learning_tpu.privacy.dp import rdp_epsilon
+
+        avg_shard = float(self.fed.client_sizes().mean())
+        q = min(1.0, self.cfg.client.batch_size / max(avg_shard, 1.0))
+        total_steps = rounds_done * self.shape.steps
+        return rdp_epsilon(
+            self.cfg.dp.noise_multiplier, q, total_steps, self.cfg.dp.delta
+        )
+
+    def evaluate(self, params) -> Dict[str, float]:
+        xb, yb, mb = self._eval_data
+        loss_sum = jnp.zeros(())
+        correct_sum = jnp.zeros(())
+        n_sum = jnp.zeros(())
+        for i in range(xb.shape[0]):
+            l, c, n = self._eval_fn(params, xb[i], yb[i], mb[i])
+            loss_sum += l
+            correct_sum += c
+            n_sum += n
+        loss, acc, n = jax.device_get((loss_sum, correct_sum, n_sum))
+        return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
+
+    def evaluate_checkpoint(self, step: Optional[int] = None) -> Dict[str, float]:
+        store = CheckpointStore(f"{self.cfg.run.out_dir}/{self.cfg.name}/ckpt")
+        template = self.init_state()
+        state, step = store.restore(step=step, template=template)
+        store.close()
+        state = self._place_state(state)
+        out = self.evaluate(state["params"])
+        out["round"] = int(state["round"])
+        return out

@@ -1,0 +1,33 @@
+"""Cohort sampler (SURVEY.md §2 C4).
+
+Stateless-by-construction: the cohort for round ``r`` is a pure function
+of ``(seed, r)`` — resume after checkpoint restore replays the exact
+same schedule with no sampler state to persist (SURVEY.md §5
+checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CohortSampler:
+    def __init__(self, num_clients: int, cohort_size: int, seed: int,
+                 weights: np.ndarray | None = None):
+        if cohort_size > num_clients:
+            raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
+        self.num_clients = num_clients
+        self.cohort_size = cohort_size
+        self.seed = seed
+        if weights is not None:
+            w = np.asarray(weights, np.float64)
+            self.probs = w / w.sum()
+        else:
+            self.probs = None
+
+    def sample(self, round_idx: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, round_idx))
+        return np.sort(
+            rng.choice(self.num_clients, size=self.cohort_size,
+                       replace=False, p=self.probs)
+        )

@@ -1,0 +1,58 @@
+"""Checkpoint / resume (SURVEY.md §2 C15, §5) on orbax.
+
+Persisted state: ``{params, server_opt_state, round, rng_key}``. The
+cohort sampler is stateless (pure function of seed+round), so resume at
+round r replays the exact schedule — determinism test §4.5 covers this
+across a save/restore boundary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointStore:
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(self.directory)
+
+    def save(self, step: int, state: Dict[str, Any], force: bool = False):
+        # rng keys aren't directly serializable; store raw key data
+        state = dict(state)
+        if "rng_key" in state:
+            state["rng_key"] = np.asarray(jax.random.key_data(state["rng_key"]))
+        self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, step: Optional[int] = None, template: Optional[Dict[str, Any]] = None):
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if template is not None:
+            template = dict(template)
+            if "rng_key" in template:
+                template["rng_key"] = np.asarray(
+                    jax.random.key_data(template["rng_key"])
+                )
+            restored = self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+        else:
+            restored = self._mngr.restore(step)
+        restored = dict(restored)
+        if "rng_key" in restored:
+            restored["rng_key"] = jax.random.wrap_key_data(
+                np.asarray(restored["rng_key"]).astype(np.uint32)
+            )
+        return restored, step
+
+    def close(self):
+        self._mngr.close()

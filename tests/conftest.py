@@ -1,0 +1,26 @@
+"""Test fixture: 8 fake CPU devices (SURVEY.md §4.3).
+
+The distributed-without-a-cluster pattern: XLA's host platform is forced
+to expose 8 devices so the *real* shard_map/psum round engine runs over
+a clients=8 mesh with no TPU pod. The axon sitecustomize force-registers
+the TPU plugin and overrides JAX_PLATFORMS, so we override back via
+jax.config before any backend initialization.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_devices():
+    assert len(jax.devices()) == 8, "conftest failed to get 8 fake CPU devices"
+    yield

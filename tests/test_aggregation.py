@@ -1,0 +1,63 @@
+"""Aggregation math vs hand-computed pytree references (SURVEY.md §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.config import ServerConfig
+from colearn_federated_learning_tpu.server.aggregation import (
+    make_server_update_fn,
+    weighted_delta_mean,
+)
+from colearn_federated_learning_tpu.utils import trees
+
+
+def _tree(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32)),
+        "b": {"c": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))},
+    }
+
+
+def test_weighted_mean_matches_hand_math():
+    ts = [_tree(i) for i in range(3)]
+    ws = [1.0, 2.0, 5.0]
+    got = weighted_delta_mean(ts, ws)
+    expect_a = (ts[0]["a"] * 1 + ts[1]["a"] * 2 + ts[2]["a"] * 5) / 8.0
+    np.testing.assert_allclose(got["a"], expect_a, rtol=1e-6)
+
+
+def test_mean_server_update_is_fedavg():
+    params = _tree(0)
+    delta = _tree(1)
+    init, update = make_server_update_fn(ServerConfig(optimizer="mean", server_lr=1.0))
+    new_params, _ = update(params, init(params), delta)
+    expect = trees.tree_add(params, delta)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), new_params, expect
+    )
+
+
+def test_fedavgm_momentum_accumulates():
+    params = _tree(0)
+    delta = _tree(1)
+    cfg = ServerConfig(optimizer="fedavgm", server_lr=1.0, server_momentum=0.5)
+    init, update = make_server_update_fn(cfg)
+    s = init(params)
+    p1, s = update(params, s, delta)
+    p2, s = update(p1, s, delta)
+    # second step: momentum buffer = delta + 0.5*delta = 1.5*delta
+    expect = trees.tree_axpy(1.5, delta, p1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6), p2, expect
+    )
+
+
+def test_fedadam_runs_and_moves_params():
+    params = _tree(0)
+    delta = _tree(1)
+    init, update = make_server_update_fn(ServerConfig(optimizer="fedadam", server_lr=0.1))
+    new_params, _ = update(params, init(params), delta)
+    moved = trees.tree_sq_norm(trees.tree_sub(new_params, params))
+    assert float(moved) > 0

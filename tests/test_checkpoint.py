@@ -1,0 +1,38 @@
+"""Checkpoint/resume (SURVEY.md §4.5 across a save/restore boundary)."""
+
+import jax
+import numpy as np
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _cfg(tmp_path, rounds):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.server.checkpoint_every = 1
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 128
+    cfg.data.synthetic_test_size = 64
+    return cfg
+
+
+def test_resume_reproduces_straight_run(tmp_path):
+    # straight 4-round run
+    straight = Experiment(_cfg(tmp_path / "straight", 4), echo=False).fit()
+
+    # 2 rounds, stop, resume to 4
+    cfg_a = _cfg(tmp_path / "resumed", 2)
+    Experiment(cfg_a, echo=False).fit()
+    cfg_b = _cfg(tmp_path / "resumed", 4)
+    cfg_b.run.resume = True
+    resumed = Experiment(cfg_b, echo=False).fit()
+
+    assert int(resumed["round"]) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        straight["params"], resumed["params"],
+    )

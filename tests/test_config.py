@@ -1,0 +1,53 @@
+import pytest
+
+from colearn_federated_learning_tpu.config import (
+    ExperimentConfig,
+    get_named_config,
+    list_named_configs,
+    resolve_config,
+)
+
+
+def test_five_named_configs_exist():
+    # BASELINE.json:7-11 — the five capability configs
+    assert list_named_configs() == sorted([
+        "mnist_fedavg_2",
+        "cifar10_fedavg_100",
+        "femnist_fedprox_500",
+        "shakespeare_fedavg",
+        "imagenet_silo_dp",
+    ])
+    for name in list_named_configs():
+        cfg = get_named_config(name)
+        assert cfg.name == name
+        cfg.validate()
+
+
+def test_yaml_roundtrip(tmp_path):
+    cfg = get_named_config("cifar10_fedavg_100")
+    path = tmp_path / "exp.yaml"
+    cfg.to_yaml(str(path))
+    back = ExperimentConfig.from_yaml(str(path))
+    assert back.to_dict() == cfg.to_dict()
+
+
+def test_overrides():
+    cfg = resolve_config("mnist_fedavg_2", {"server.num_rounds": 3, "client.lr": 0.5})
+    assert cfg.server.num_rounds == 3
+    assert cfg.client.lr == 0.5
+    with pytest.raises(KeyError):
+        resolve_config("mnist_fedavg_2", {"server.bogus": 1})
+
+
+def test_validation_rejects_bad_cohort():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.cohort_size = 99
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_fedprox_requires_mu():
+    cfg = get_named_config("femnist_fedprox_500")
+    cfg.client.prox_mu = 0.0
+    with pytest.raises(ValueError):
+        cfg.validate()

@@ -1,0 +1,57 @@
+import numpy as np
+
+from colearn_federated_learning_tpu.config import ClientConfig, DataConfig
+from colearn_federated_learning_tpu.data import build_federated_data
+from colearn_federated_learning_tpu.data.loader import (
+    RoundShape,
+    compute_round_shape,
+    eval_batches,
+    make_round_indices,
+)
+
+
+def test_round_shape_derivation():
+    cfg = DataConfig(name="mnist", num_clients=4, synthetic_train_size=400)
+    fed = build_federated_data(cfg, seed=0)
+    shape = compute_round_shape(fed, ClientConfig(local_epochs=2, batch_size=32), cfg)
+    assert shape.cap == 100
+    assert shape.steps_per_epoch == 4  # ceil(100/32)
+    assert shape.steps == 8
+
+
+def test_round_indices_mask_and_weights():
+    cfg = DataConfig(name="mnist", num_clients=5, synthetic_train_size=333)
+    fed = build_federated_data(cfg, seed=1)
+    shape = compute_round_shape(fed, ClientConfig(local_epochs=3, batch_size=16), cfg)
+    rng = np.random.default_rng(0)
+    idx, mask, n_ex = make_round_indices(fed, [0, 2, 4], shape, rng)
+    assert idx.shape == mask.shape == (3, shape.steps, 16)
+    for row, cid in enumerate([0, 2, 4]):
+        n_real = min(len(fed.client_indices[cid]), shape.cap)
+        assert mask[row].sum() == n_real * 3
+        assert n_ex[row] == n_real * 3
+        # all unmasked indices belong to this client's shard
+        real = idx[row][mask[row] > 0]
+        assert set(real.tolist()) <= set(fed.client_indices[cid].tolist())
+
+
+def test_round_indices_cover_each_epoch():
+    fed_ids = [np.arange(10, 20)]
+
+    class F:
+        client_indices = fed_ids
+
+    shape = RoundShape(local_epochs=2, steps_per_epoch=2, batch_size=8, cap=10)
+    idx, mask, n_ex = make_round_indices(F(), [0], shape, np.random.default_rng(0))
+    flat_idx, flat_mask = idx.reshape(2, -1), mask.reshape(2, -1)  # per epoch
+    for e in range(2):
+        seen = flat_idx[e][flat_mask[e] > 0]
+        np.testing.assert_array_equal(np.sort(seen), np.arange(10, 20))
+
+
+def test_eval_batches_padding():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    y = np.arange(10, dtype=np.int32)
+    xb, yb, mb = eval_batches(x, y, 4)
+    assert xb.shape == (3, 4, 1)
+    assert mb.sum() == 10

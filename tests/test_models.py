@@ -1,0 +1,54 @@
+"""Model zoo forward-shape and param-purity checks (SURVEY.md §2 C9)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from colearn_federated_learning_tpu.models import build_model, init_params
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,in_shape,in_dtype,out_shape",
+    [
+        ("lenet5", {"num_classes": 10}, (28, 28, 1), jnp.float32, (2, 10)),
+        ("resnet18", {"num_classes": 10}, (32, 32, 3), jnp.float32, (2, 10)),
+        ("mobilenetv2", {"num_classes": 62}, (28, 28, 1), jnp.float32, (2, 62)),
+        ("bert_tiny", {"num_classes": 0, "vocab_size": 90, "seq_len": 16},
+         (16,), jnp.int32, (2, 16, 90)),
+        ("vit_b16", {"num_classes": 10, "image_size": 32}, (32, 32, 3),
+         jnp.float32, (2, 10)),
+    ],
+)
+def test_forward_shapes(name, kwargs, in_shape, in_dtype, out_shape):
+    model = build_model(name.split(":")[0], **kwargs)
+    params = init_params(model, in_shape, seed=0, input_dtype=in_dtype)
+    if in_dtype == jnp.int32:
+        x = jnp.zeros((2,) + in_shape, in_dtype)
+    else:
+        x = jnp.ones((2,) + in_shape, in_dtype)
+    out = model.apply({"params": params}, x, train=False)
+    assert out.shape == out_shape
+    assert out.dtype == jnp.float32  # logits always f32 for stable CE
+    # params must be a pure pytree of inexact arrays (aggregatable)
+    for leaf in jax.tree.leaves(params):
+        assert jnp.issubdtype(leaf.dtype, jnp.inexact)
+
+
+def test_no_batch_stats_collections():
+    """FL invariant: no mutable batch statistics (GroupNorm everywhere)."""
+    for name, kwargs, shape, dtype in [
+        ("resnet18", {"num_classes": 10}, (32, 32, 3), jnp.float32),
+        ("mobilenetv2", {"num_classes": 62}, (28, 28, 1), jnp.float32),
+    ]:
+        model = build_model(name, **kwargs)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.ones((1,) + shape, dtype), train=True
+        )
+        assert set(variables.keys()) == {"params"}, name
+
+
+def test_bfloat16_compute_dtype():
+    model = build_model("resnet18", num_classes=10, compute_dtype=jnp.bfloat16)
+    params = init_params(model, (32, 32, 3), seed=0)
+    out = model.apply({"params": params}, jnp.ones((2, 32, 32, 3)), train=False)
+    assert out.dtype == jnp.float32

@@ -1,0 +1,71 @@
+"""Partitioner property tests (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.data import partition as P
+
+
+def _labels(n=4000, classes=10, seed=0):
+    return np.random.default_rng(seed).integers(0, classes, n).astype(np.int32)
+
+
+def _assert_partition(shards, n):
+    allidx = np.concatenate(shards)
+    assert len(allidx) == n
+    assert len(np.unique(allidx)) == n  # disjoint + complete
+
+
+def test_iid_partitions_index_set():
+    shards = P.iid_partition(1000, 7, seed=0)
+    _assert_partition(shards, 1000)
+
+
+def test_dirichlet_partitions_index_set():
+    y = _labels()
+    shards = P.dirichlet_partition(y, 20, 10, alpha=0.5, seed=1)
+    _assert_partition(shards, len(y))
+
+
+def test_dirichlet_alpha_extremes():
+    y = _labels()
+    # α→∞: every client's class histogram ≈ global (IID)
+    iid_shards = P.dirichlet_partition(y, 10, 10, alpha=1e6, seed=2)
+    for s in iid_shards:
+        hist = np.bincount(y[s], minlength=10) / len(s)
+        assert np.abs(hist - 0.1).max() < 0.05
+    # α→0: each CLASS concentrates on (essentially) one client. Fewer
+    # clients than classes so the min_size retry can succeed.
+    skew_shards = P.dirichlet_partition(y, 5, 10, alpha=1e-3, seed=3)
+    per_class_client = np.zeros((10, 5))
+    for ci, s in enumerate(skew_shards):
+        per_class_client[:, ci] = np.bincount(y[s], minlength=10)
+    concentration = per_class_client.max(1) / per_class_client.sum(1)
+    assert concentration.min() > 0.95
+
+
+def test_dirichlet_deterministic():
+    y = _labels()
+    a = P.dirichlet_partition(y, 8, 10, alpha=0.3, seed=7)
+    b = P.dirichlet_partition(y, 8, 10, alpha=0.3, seed=7)
+    for s1, s2 in zip(a, b):
+        np.testing.assert_array_equal(s1, s2)
+
+
+def test_natural_partition_merges_groups():
+    rng = np.random.default_rng(0)
+    # 20 "writers" with heterogeneous sizes → 5 clients
+    sizes = rng.integers(5, 100, 20)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    groups = [np.arange(offsets[i], offsets[i + 1]) for i in range(20)]
+    shards = P.natural_partition(groups, 5, seed=0)
+    _assert_partition(shards, int(sizes.sum()))
+    # balancing: largest client ≤ 2× smallest
+    szs = [len(s) for s in shards]
+    assert max(szs) <= 2 * min(szs)
+
+
+def test_natural_partition_rejects_too_few_groups():
+    groups = [np.arange(10)]
+    with pytest.raises(ValueError):
+        P.natural_partition(groups, 2, seed=0)

@@ -1,0 +1,104 @@
+"""The distributed-without-a-cluster test (SURVEY.md §4.3): the real
+shard_map/psum round engine over a clients=8 CPU mesh must match the
+sequential reference loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import ClientConfig, DPConfig, ServerConfig
+from colearn_federated_learning_tpu.data.loader import RoundShape, make_round_indices
+from colearn_federated_learning_tpu.models import build_model, init_params
+from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh, largest_lane_count
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+
+
+class _Fed:
+    """Minimal FederatedData stand-in for index building."""
+
+    def __init__(self, client_indices):
+        self.client_indices = client_indices
+
+
+def _setup(cohort=8, n=256):
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+    # heterogeneous client sizes
+    splits = np.array_split(rng.permutation(n), cohort)
+    fed = _Fed([s[: rng.integers(8, len(s) + 1)] for s in splits])
+    shape = RoundShape(local_epochs=2, steps_per_epoch=4, batch_size=8, cap=32)
+    idx, mask, n_ex = make_round_indices(fed, list(range(cohort)), shape, rng)
+    return model, params, x, y, idx, mask, n_ex
+
+
+@pytest.mark.parametrize("lanes", [8, 4, 1])
+def test_sharded_matches_sequential(lanes):
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    _, server_update = make_server_update_fn(scfg)
+    init, _ = make_server_update_fn(scfg)
+
+    mesh = build_client_mesh(lanes)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False,
+    )
+    sequential = make_sequential_round_fn(model, ccfg, DPConfig(), "classify", server_update)
+
+    opt_state = init(params)  # placeholder init fn returns opt state
+    rng = jax.random.PRNGKey(42)
+    p_sh, _, m_sh = sharded(params, opt_state, x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex), rng)
+    p_sq, _, m_sq = sequential(params, opt_state, x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex), rng)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_sh, p_sq,
+    )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+    np.testing.assert_allclose(m_sh.examples, m_sq.examples, rtol=1e-6)
+
+
+def test_dropout_zero_weight_removes_client():
+    """A client with weight 0 must not influence the aggregate (exact)."""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(8)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False,
+    )
+    rng = jax.random.PRNGKey(0)
+    opt_state = init(params)
+
+    n_dropped = n_ex.copy()
+    n_dropped[3] = 0.0
+    p_drop, _, _ = sharded(params, opt_state, x, y, jnp.asarray(idx), jnp.asarray(mask),
+                           jnp.asarray(n_dropped), rng)
+
+    # corrupt client 3's data entirely: must not change the result
+    idx2 = idx.copy()
+    idx2[3] = 0
+    p_drop2, _, _ = sharded(params, opt_state, x, y, jnp.asarray(idx2), jnp.asarray(mask),
+                            jnp.asarray(n_dropped), rng)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        p_drop, p_drop2,
+    )
+
+
+def test_largest_lane_count():
+    assert largest_lane_count(16, 8) == 8
+    assert largest_lane_count(12, 8) == 6
+    assert largest_lane_count(11, 8) == 1
+    assert largest_lane_count(7, 8) == 7
