@@ -16,7 +16,8 @@ import json
 import time
 
 # First recorded rounds/sec on 1× TPU v5 lite (see BASELINE.md measurements
-# table): 2026-07-29, commit of milestone S0-S2.
+# table): 2026-07-29, commit of milestone S0-S2. Later entries in that table
+# track improvements against this number (bench reports vs_baseline).
 BASELINE_ROUNDS_PER_SEC = 2.22
 
 WARMUP_ROUNDS = 2
@@ -43,19 +44,22 @@ def main():
     state = exp.init_state()
     state = exp._place_state(state)
 
-    # Each round's train-loss scalar is fetched inside the timed region —
-    # that is what the real driver does every round, and it forces true
-    # execution (block_until_ready alone does not sync through the axon
-    # remote-execution relay).
-    last_loss = 0.0
+    # Rounds are dispatched asynchronously (the driver's production mode:
+    # run.metrics_flush_every batches metric fetches); the timed region
+    # ends with ONE metrics drain, which forces execution of every round
+    # (each depends on the previous round's params). block_until_ready
+    # alone does not sync through the axon remote-execution relay.
     for r in range(WARMUP_ROUNDS):
         state = exp.run_round(state, r)
         last_loss = float(state.pop("_metrics").train_loss)
 
     t0 = time.perf_counter()
+    pending = []
     for r in range(WARMUP_ROUNDS, WARMUP_ROUNDS + TIMED_ROUNDS):
         state = exp.run_round(state, r)
-        last_loss = float(state.pop("_metrics").train_loss)
+        pending.append(state.pop("_metrics"))
+    fetched = jax.device_get(pending)
+    last_loss = float(fetched[-1].train_loss)
     dt = time.perf_counter() - t0
 
     rounds_per_sec = TIMED_ROUNDS / dt

@@ -94,6 +94,15 @@ class RunConfig:
     num_lanes: int = 0
     # second mesh axis for intra-client batch DP on big silo models; 1 = off
     batch_shards: int = 1
+    # clients trained as one vmap block per lane (effective batch =
+    # width × batch_size keeps the MXU fed for small models); 1 = pure
+    # sequential scan (min memory), 0 = whole lane in one vmap
+    client_vmap_width: int = 1
+    # rounds between metric fetches. Dispatch is async; only host fetches
+    # pay the device round-trip (~100ms through this sandbox's relay), so
+    # the driver buffers per-round metric scalars on device and drains
+    # them every N rounds. 1 = fetch every round (debug).
+    metrics_flush_every: int = 10
     out_dir: str = "runs"
     resume: bool = False
     profile_round: int = -1  # round index to wrap in jax.profiler.trace; -1 = off

@@ -47,7 +47,8 @@ class RoundMetrics(NamedTuple):
 
 
 def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
-                          cohort_size: int, donate: bool = True):
+                          cohort_size: int, donate: bool = True,
+                          client_vmap_width: int = 1):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -59,31 +60,59 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     ``n_ex`` are the FedAvg weights; simulated client dropout
     (SURVEY.md §5) is upstream zeroing of entries — exact math, no
     control-flow divergence.
+
+    ``client_vmap_width``: how many of a lane's clients train as one
+    ``vmap`` block (effective conv/matmul batch = width × batch_size —
+    what keeps the MXU fed when per-client batches are small). 1 = pure
+    sequential ``lax.scan`` (minimum memory); 0 = the whole lane in one
+    vmap; any other value must exactly divide the lane's client count
+    (raises otherwise — never silently rewritten). Peak memory scales
+    with width (one activation set per vmapped client), so big-model
+    configs keep it low.
     """
     local_train = make_local_train_fn(model, client_cfg, dp_cfg, task)
     n_lanes = mesh.shape[CLIENT_AXIS]
     if cohort_size % n_lanes != 0:
         raise ValueError(f"cohort {cohort_size} not divisible by lanes {n_lanes}")
+    clients_per_lane = cohort_size // n_lanes
+    width = client_vmap_width if client_vmap_width > 0 else clients_per_lane
+    if width > clients_per_lane or clients_per_lane % width != 0:
+        raise ValueError(
+            f"client_vmap_width {width} must divide the {clients_per_lane} "
+            f"clients per lane (cohort {cohort_size} / {n_lanes} lanes); "
+            f"use 0 for the full lane"
+        )
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
         # Mark params as device-varying so scan carries (which mix in
         # per-lane data) type-check under shard_map's vma system.
         params = _pcast_varying(params)
-        def per_client(acc, inp):
-            c_idx, c_mask, c_n, c_key = inp
-            w_i, m_i = local_train(params, train_x, train_y, c_idx, c_mask, c_key)
-            delta = trees.tree_sub(w_i, params)
-            d_acc, n_acc, l_acc = acc
-            d_acc = trees.tree_axpy(c_n, delta, d_acc)
-            return (d_acc, n_acc + c_n, l_acc + c_n * m_i.loss), None
 
+        def per_block(acc, inp):
+            b_idx, b_mask, b_n, b_keys = inp  # leading axis: width (vmapped)
+            w_b, m_b = jax.vmap(
+                local_train, in_axes=(None, None, None, 0, 0, 0)
+            )(params, train_x, train_y, b_idx, b_mask, b_keys)
+            d_acc, n_acc, l_acc = acc
+            # Σ over the block of n_i·(w_i − w₀), fused as one contraction
+            d_acc = jax.tree.map(
+                lambda a, w, p: a + jnp.einsum(
+                    "c,c...->...", b_n.astype(w.dtype), w - p[None]
+                ).astype(a.dtype),
+                d_acc, w_b, params,
+            )
+            return (d_acc, n_acc + b_n.sum(), l_acc + (b_n * m_b.loss).sum()), None
+
+        n_blocks = idx.shape[0] // width
+        blocked = jax.tree.map(
+            lambda a: a.reshape((n_blocks, width) + a.shape[1:]),
+            (idx, mask, n_ex, keys),
+        )
         acc0 = _pcast_varying(
             (trees.tree_zeros_like(params), jnp.zeros(()), jnp.zeros(()))
         )
-        (d_sum, n_sum, l_sum), _ = jax.lax.scan(
-            per_client, acc0, (idx, mask, n_ex, keys)
-        )
+        (d_sum, n_sum, l_sum), _ = jax.lax.scan(per_block, acc0, blocked)
         # The aggregation collective — the reference's NCCL allreduce
         # (BASELINE.json:5) as a single XLA psum over the ICI.
         d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)

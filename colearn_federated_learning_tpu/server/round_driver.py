@@ -33,7 +33,7 @@ from colearn_federated_learning_tpu.parallel.round_engine import (
 from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
 from colearn_federated_learning_tpu.server.sampler import CohortSampler
 from colearn_federated_learning_tpu.utils.checkpoint import CheckpointStore
-from colearn_federated_learning_tpu.utils.metrics import MetricsLogger, Throughput
+from colearn_federated_learning_tpu.utils.metrics import MetricsLogger
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
@@ -75,6 +75,7 @@ class Experiment:
             self.round_fn = make_sharded_round_fn(
                 self.model, cfg.client, cfg.dp, self.task, self.mesh,
                 server_update, cfg.server.cohort_size,
+                client_vmap_width=cfg.run.client_vmap_width,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.client_sharded(self.mesh)
@@ -174,38 +175,73 @@ class Experiment:
             else:
                 state = self.init_state()
         state = self._place_state(state)
-        thr = Throughput(self.n_chips)
         start_round = int(state["round"])
         t_start = time.perf_counter()
+
+        # Rounds are DISPATCHED asynchronously; per-round metric scalars
+        # stay on device in `pending` and are drained in one device_get at
+        # flush boundaries. Host↔device round-trips (the expensive part on
+        # a tunneled chip) happen once per flush, not once per round.
+        # Throughput is measured per flush window (dispatch timestamps are
+        # meaningless under async execution); the first window includes
+        # compile time.
+        flush_every = max(1, cfg.run.metrics_flush_every)
+        if cfg.run.sanitize:
+            flush_every = 1  # sanitize wants per-round finiteness checks
+        pending = []  # (round_idx, RoundMetrics-on-device)
+        flush_t0 = time.perf_counter()
+
+        def flush(current_state):
+            nonlocal flush_t0
+            if not pending:
+                return
+            fetched = jax.device_get([m for _, m in pending])
+            dt = time.perf_counter() - flush_t0
+            rounds_per_sec = len(pending) / dt if dt > 0 else 0.0
+            updates_per_sec = (
+                rounds_per_sec * cfg.server.cohort_size / self.n_chips
+            )
+            for (ridx, _), m in zip(pending, fetched):
+                record = {
+                    "round": ridx + 1,
+                    "train_loss": float(m.train_loss),
+                    "examples": float(m.examples),
+                }
+                if cfg.dp.enabled:
+                    record["dp_epsilon"] = round(self.dp_epsilon(ridx + 1), 4)
+                if ridx == pending[-1][0]:
+                    record["rounds_per_sec"] = round(rounds_per_sec, 4)
+                    record["client_updates_per_sec_per_chip"] = round(updates_per_sec, 4)
+                    if cfg.server.eval_every and (ridx + 1) % cfg.server.eval_every == 0:
+                        record.update(self.evaluate(current_state["params"]))
+                self.logger.log(record)
+            pending.clear()
+            flush_t0 = time.perf_counter()
+
         for r in range(start_round, cfg.server.num_rounds):
             profiling = r == cfg.run.profile_round
             if profiling:
+                flush(state)
                 jax.profiler.start_trace(f"{cfg.run.out_dir}/{cfg.name}/profile")
             state = self.run_round(state, r)
-            metrics = state.pop("_metrics")
+            pending.append((r, state.pop("_metrics")))
             if profiling:
                 jax.tree.map(lambda x: x.block_until_ready(), state["params"])
                 jax.profiler.stop_trace()
-            thr.mark(cfg.server.cohort_size)
-            record = {
-                "round": r + 1,
-                "train_loss": float(metrics.train_loss),
-                "examples": float(metrics.examples),
-                **{k: round(v, 4) for k, v in thr.rates().items()},
-            }
+            at_eval = cfg.server.eval_every and (r + 1) % cfg.server.eval_every == 0
+            at_ckpt = store and cfg.server.checkpoint_every and (r + 1) % cfg.server.checkpoint_every == 0
+            if len(pending) >= flush_every or at_eval or at_ckpt or r + 1 == cfg.server.num_rounds:
+                flush(state)
             if cfg.run.sanitize:
                 finite = all(
                     bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(state["params"])
                 )
                 if not finite:
                     raise FloatingPointError(f"non-finite params after round {r + 1}")
-            if cfg.dp.enabled:
-                record["dp_epsilon"] = round(self.dp_epsilon(r + 1), 4)
-            if cfg.server.eval_every and (r + 1) % cfg.server.eval_every == 0:
-                record.update(self.evaluate(state["params"]))
-            self.logger.log(record)
-            if store and cfg.server.checkpoint_every and (r + 1) % cfg.server.checkpoint_every == 0:
+            if at_ckpt:
                 store.save(r + 1, state)
+                flush_t0 = time.perf_counter()  # keep save time out of the next window
+        flush(state)
         state["wall_time"] = time.perf_counter() - t_start
         if store:
             if store.latest_step() != int(state["round"]):

@@ -1,9 +1,10 @@
 """Metrics / logging / observability (SURVEY.md §2 C14, §5).
 
 Per-round JSONL records with the judged metrics (FL rounds/sec,
-client-updates/sec/chip — BASELINE.json:2). Device metrics are fetched
-with a single ``jax.device_get`` per round by the driver; this module is
-pure host-side bookkeeping.
+client-updates/sec/chip — BASELINE.json:2). The driver batches device
+metric fetches per flush window (``run.metrics_flush_every``) and
+computes throughput over those windows; this module is pure host-side
+bookkeeping.
 """
 
 from __future__ import annotations
@@ -38,26 +39,3 @@ class MetricsLogger:
             print(json.dumps(shown), flush=True)
 
 
-class Throughput:
-    """Rolling rounds/sec + client-updates/sec/chip over the last window."""
-
-    def __init__(self, n_chips: int, window: int = 20):
-        self.n_chips = max(1, n_chips)
-        self.window = window
-        self.marks = []
-
-    def mark(self, cohort_size: int):
-        self.marks.append((time.perf_counter(), cohort_size))
-        if len(self.marks) > self.window:
-            self.marks.pop(0)
-
-    def rates(self):
-        if len(self.marks) < 2:
-            return {"rounds_per_sec": 0.0, "client_updates_per_sec_per_chip": 0.0}
-        dt = self.marks[-1][0] - self.marks[0][0]
-        n_rounds = len(self.marks) - 1
-        n_updates = sum(c for _, c in self.marks[1:])
-        return {
-            "rounds_per_sec": n_rounds / dt if dt > 0 else 0.0,
-            "client_updates_per_sec_per_chip": n_updates / dt / self.n_chips if dt > 0 else 0.0,
-        }

@@ -97,6 +97,49 @@ def test_dropout_zero_weight_removes_client():
     )
 
 
+@pytest.mark.parametrize("width", [0, 2, 4])
+def test_vmap_width_matches_scan(width):
+    """vmapped-clients blocks must compute the same round as pure scan."""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(2)
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
+            jax.random.PRNGKey(3))
+    opt_state = init(params)
+    scan_fn = make_sharded_round_fn(model, ccfg, DPConfig(), "classify", mesh,
+                                    server_update, 8, donate=False,
+                                    client_vmap_width=1)
+    vmap_fn = make_sharded_round_fn(model, ccfg, DPConfig(), "classify", mesh,
+                                    server_update, 8, donate=False,
+                                    client_vmap_width=width)
+    p_scan, _, m_scan = scan_fn(params, opt_state, *args)
+    p_vmap, _, m_vmap = vmap_fn(params, opt_state, *args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_scan, p_vmap,
+    )
+    np.testing.assert_allclose(m_scan.train_loss, m_vmap.train_loss, rtol=1e-5)
+
+
+def test_dp_under_sharded_engine():
+    """Regression: DP-SGD inside shard_map (scan-carry vma typing)."""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1)
+    dcfg = DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=1.0,
+                    microbatch_size=4)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(4)
+    fn = make_sharded_round_fn(model, ccfg, dcfg, "classify", mesh,
+                               server_update, 8, donate=False)
+    p, _, m = fn(params, init(params), x, y, jnp.asarray(idx),
+                 jnp.asarray(mask), jnp.asarray(n_ex), jax.random.PRNGKey(0))
+    assert np.isfinite(float(m.train_loss))
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(p))
+
+
 def test_largest_lane_count():
     assert largest_lane_count(16, 8) == 8
     assert largest_lane_count(12, 8) == 6
