@@ -1,0 +1,141 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support (first-class per the project brief, even though the
+reference's own models are short-sequence — SURVEY.md §5 records that):
+queries stay put, key/value blocks rotate around the ring of devices via
+``jax.lax.ppermute`` while a blockwise online-softmax (the flash-
+attention recurrence) accumulates exact results — memory per device is
+O(T/n) with no T×T materialization, and the rotation rides the ICI.
+
+Liu et al. 2023 (Ring Attention with Blockwise Transformers) is the
+published recipe; this is an independent implementation on
+``shard_map``/``ppermute``.
+
+Intended use: inside ``jax.shard_map`` with the sequence axis sharded
+over ``axis_name``, e.g.::
+
+    mesh = Mesh(devices, ("seq",))
+    attn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, heads, "seq", causal=True),
+        mesh=mesh, in_specs=P(None, "seq", None), out_specs=P(None, "seq", None),
+    )
+
+``parallel/sequence.py`` wires this into a full transformer forward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_BIG = -1e30  # finite stand-in for -inf: keeps the online softmax NaN-free
+
+
+def _split_heads(x, heads: int):
+    b, t, d = x.shape
+    return x.reshape(b, t, heads, d // heads).transpose(0, 2, 1, 3)  # [B,H,T,hd]
+
+
+def _merge_heads(x):
+    b, h, t, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+
+
+def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
+    """Exact multi-head attention with q/k/v sequence-sharded on ``axis_name``.
+
+    q, k, v: [B, T_local, D] (this device's sequence block).
+    Returns [B, T_local, D] — identical (up to float reassociation) to
+    full attention over the gathered sequence.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    qh = _split_heads(q, heads)
+    kh = _split_heads(k, heads)
+    vh = _split_heads(v, heads)
+    b, h, t_loc, hd = qh.shape
+    scale = hd**-0.5
+    qh = qh * scale
+    q_pos = me * t_loc + jnp.arange(t_loc)  # global positions of our queries
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        o, m, l, kh_cur, vh_cur = carry
+        # the block we currently hold originated at lane (me - step) mod n
+        src = jax.lax.rem(me - step + n, n)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh_cur).astype(jnp.float32)
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            keep = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            s = jnp.where(keep[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(-1))
+        # correction for previously accumulated numerator/denominator
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(keep[None, None], p, 0.0)  # kill exp(0) on dead rows
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vh_cur.dtype), vh_cur
+        ).astype(jnp.float32)
+        kh_next = jax.lax.ppermute(kh_cur, axis_name, perm)
+        vh_next = jax.lax.ppermute(vh_cur, axis_name, perm)
+        return (o_new, m_new, l_new, kh_next, vh_next), None
+
+    o0 = jnp.zeros((b, h, t_loc, hd), jnp.float32)
+    m0 = jnp.full((b, h, t_loc), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, kh, vh), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return _merge_heads(out.astype(q.dtype))
+
+
+def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = True):
+    """Single-device blockwise (flash-style) attention: same online-softmax
+    recurrence as the ring, scanning k/v blocks from HBM instead of the
+    ICI. Exact; O(T·block) memory. Used for long sequences on one chip
+    and as the numerics oracle for the ring version."""
+    qh = _split_heads(q, heads)
+    kh = _split_heads(k, heads)
+    vh = _split_heads(v, heads)
+    b, h, t, hd = qh.shape
+    assert t % block_size == 0, (t, block_size)
+    n_blocks = t // block_size
+    scale = hd**-0.5
+    qh = qh * scale
+    kb = kh.reshape(b, h, n_blocks, block_size, hd)
+    vb = vh.reshape(b, h, n_blocks, block_size, hd)
+    q_pos = jnp.arange(t)
+
+    def body(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, blk_idx = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, k_blk).astype(jnp.float32)
+        if causal:
+            k_pos = blk_idx * block_size + jnp.arange(block_size)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(keep[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(keep[None, None], p, 0.0)
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk
+        ).astype(jnp.float32)
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, t, hd), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(
+        body,
+        (o0, m0, l0),
+        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4), jnp.arange(n_blocks)),
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return _merge_heads(out.astype(q.dtype))
