@@ -9,6 +9,7 @@ host work is just index-tensor construction and a scalar metrics fetch.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -162,11 +163,15 @@ class Experiment:
 
     # ------------------------------------------------------------------
 
+    def _run_dir(self) -> str:
+        """Base directory for this run's artifacts; out_dir="" → cwd."""
+        return os.path.join(self.cfg.run.out_dir or ".", self.cfg.name)
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         cfg = self.cfg
         store = None
         if cfg.run.out_dir:
-            store = CheckpointStore(f"{cfg.run.out_dir}/{cfg.name}/ckpt")
+            store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
         if state is None:
             if cfg.run.resume and store and store.latest_step() is not None:
                 template = self.init_state()
@@ -222,11 +227,14 @@ class Experiment:
             profiling = r == cfg.run.profile_round
             if profiling:
                 flush(state)
-                jax.profiler.start_trace(f"{cfg.run.out_dir}/{cfg.name}/profile")
+                jax.profiler.start_trace(os.path.join(self._run_dir(), "profile"))
             state = self.run_round(state, r)
             pending.append((r, state.pop("_metrics")))
             if profiling:
-                jax.tree.map(lambda x: x.block_until_ready(), state["params"])
+                # A scalar fetch, not block_until_ready: on a relayed chip
+                # only a device_get truly forces execution, and the trace
+                # must contain the round's device compute.
+                jax.device_get(pending[-1][1].train_loss)
                 jax.profiler.stop_trace()
             at_eval = cfg.server.eval_every and (r + 1) % cfg.server.eval_every == 0
             at_ckpt = store and cfg.server.checkpoint_every and (r + 1) % cfg.server.checkpoint_every == 0
@@ -280,7 +288,7 @@ class Experiment:
         return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
 
     def evaluate_checkpoint(self, step: Optional[int] = None) -> Dict[str, float]:
-        store = CheckpointStore(f"{self.cfg.run.out_dir}/{self.cfg.name}/ckpt")
+        store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
         template = self.init_state()
         state, step = store.restore(step=step, template=template)
         store.close()
