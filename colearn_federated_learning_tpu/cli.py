@@ -81,6 +81,14 @@ def main(argv=None):
             print(name)
         return 0
 
+    # multi-host bring-up must precede any backend touch (SURVEY.md §3.5);
+    # no-op unless COLEARN_COORDINATOR is set (TPU pods auto-detect inside)
+    from colearn_federated_learning_tpu.parallel.distributed import (
+        maybe_initialize_from_env,
+    )
+
+    maybe_initialize_from_env()
+
     overrides = _parse_overrides(args.overrides)
     if args.out_dir is not None:
         overrides["run.out_dir"] = args.out_dir

@@ -53,8 +53,13 @@ def make_client_optimizer(cfg: ClientConfig) -> optax.GradientTransformation:
     return opt
 
 
-def make_loss_fn(model, task: str):
-    """Masked-mean loss. classify: y [B] ints; lm: y [B,T] next tokens."""
+def make_loss_fn(model, task: str, reduction: str = "mean"):
+    """Masked loss. classify: y [B] ints; lm: y [B,T] next tokens.
+
+    ``reduction="sum"`` returns the plain mask-weighted sum — what the
+    batch-sharded path needs, where the mean's denominator spans all
+    batch shards and is applied after the cross-shard psum.
+    """
 
     def loss_fn(params, x, y, m):
         logits = model.apply({"params": params}, x, train=True)
@@ -62,8 +67,10 @@ def make_loss_fn(model, task: str):
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         else:  # lm: mean over tokens within each example
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(-1)
-        denom = jnp.maximum(m.sum(), 1.0)
-        return (ce * m).sum() / denom
+        weighted = (ce * m).sum()
+        if reduction == "sum":
+            return weighted
+        return weighted / jnp.maximum(m.sum(), 1.0)
 
     return loss_fn
 
@@ -72,27 +79,63 @@ def _select_tree(pred, new, old):
     return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
 
 
-def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str):
-    """Build the pure local-training function for one client-round."""
+def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str,
+                        batch_axis: str | None = None):
+    """Build the pure local-training function for one client-round.
+
+    ``batch_axis``: when the mesh carries a second axis that data-parallels
+    each client's minibatch (mesh.py ``BATCH_AXIS``), every shard holds
+    ``batch / batch_shards`` examples of each step; the batch gradient is
+    the psum of per-shard mask-weighted grad sums divided by the psummed
+    mask count — exactly the full-batch masked mean, so results are
+    bit-close to the unsharded path.
+    """
     opt = make_client_optimizer(client_cfg)
-    loss_fn = make_loss_fn(model, task)
-    grad_fn = jax.value_and_grad(loss_fn)
+    grad_fn = jax.value_and_grad(make_loss_fn(model, task))
+    sum_grad_fn = jax.value_and_grad(make_loss_fn(model, task, reduction="sum"))
     mu = client_cfg.prox_mu
     if dp_cfg.enabled:
-        dp_grad_fn = dp_lib.make_dp_grad_fn(loss_fn, dp_cfg)
+        dp_grad_fn = dp_lib.make_dp_grad_fn(
+            make_loss_fn(model, task), dp_cfg, batch_axis=batch_axis
+        )
+
+    def _global_count(m):
+        n = m.sum()
+        return jax.lax.psum(n, batch_axis) if batch_axis else n
+
+    def _batch_varying(tree):
+        # Params arrive batch-INVARIANT (replicated over batch shards).
+        # Differentiating a batch-varying loss wrt invariant params makes
+        # shard_map's reverse-mode AD psum the cotangents automatically;
+        # combined with our explicit psum that double-counts. Casting to
+        # varying first keeps grads local so the explicit psum is the only
+        # cross-shard sum (type cast only — no communication).
+        return jax.tree.map(
+            lambda p: jax.lax.pcast(p, (batch_axis,), to="varying"), tree
+        )
 
     def local_train(global_params, train_x, train_y, idx, mask, rng):
-        """idx/mask: [steps, batch]; returns (params, LocalMetrics)."""
+        """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics)."""
 
         def step(carry, inp):
             params, opt_state = carry
             step_idx, step_mask, key = inp
             x = jnp.take(train_x, step_idx, axis=0)
             y = jnp.take(train_y, step_idx, axis=0)
+            step_n = _global_count(step_mask)  # identical on all batch shards
             if dp_cfg.enabled:
                 loss, grads = dp_grad_fn(params, x, y, step_mask, key)
-            else:
+            elif batch_axis is None:
                 loss, grads = grad_fn(params, x, y, step_mask)
+            else:
+                sum_loss, sum_grads = sum_grad_fn(
+                    _batch_varying(params), x, y, step_mask
+                )
+                denom = jnp.maximum(step_n, 1.0)
+                loss = jax.lax.psum(sum_loss, batch_axis) / denom
+                grads = jax.tree.map(
+                    lambda g: jax.lax.psum(g, batch_axis) / denom, sum_grads
+                )
             if mu > 0.0:
                 # exact ∇ of μ/2‖w−w₀‖² — FedProx's proximal pull
                 grads = jax.tree.map(
@@ -100,17 +143,30 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
                 )
             updates, new_opt_state = opt.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            valid = step_mask.sum() > 0
+            # validity must be judged on the GLOBAL mask so batch shards
+            # never diverge on whether a padded step applied
+            valid = step_n > 0
             params = _select_tree(valid, new_params, params)
             opt_state = _select_tree(valid, new_opt_state, opt_state)
-            return (params, opt_state), loss * step_mask.sum()
+            return (params, opt_state), loss * step_n
 
         steps = idx.shape[0]
         keys = jax.random.split(rng, steps)
-        (params, _), weighted_losses = jax.lax.scan(
-            step, (global_params, opt.init(global_params)), (idx, mask, keys)
+        # Freshly created optimizer-state leaves (e.g. adam's int32 step
+        # count) are device-invariant under shard_map while the scan
+        # output is varying; tie every leaf to the data (+0·Σmask, exact)
+        # so the carry type is uniform in both the sharded lane and the
+        # sequential engine — same trick as privacy/dp.py's accumulators.
+        # Under a batch axis the tie-in must be the psummed count, which is
+        # batch-invariant like the params carry itself.
+        vary0 = 0.0 * _global_count(mask)
+        opt_state0 = jax.tree.map(
+            lambda x: x + vary0.astype(x.dtype), opt.init(global_params)
         )
-        n = mask.sum()
+        (params, _), weighted_losses = jax.lax.scan(
+            step, (global_params, opt_state0), (idx, mask, keys)
+        )
+        n = _global_count(mask)
         mean_loss = weighted_losses.sum() / jnp.maximum(n, 1.0)
         return params, LocalMetrics(loss=mean_loss, examples=n)
 

@@ -109,8 +109,16 @@ def _scaled_train_size(cfg: DataConfig) -> int:
     return max(cfg.synthetic_train_size, cfg.num_clients * 32)
 
 
-def _image_loader(name: str, shape, num_classes: int, real_fn):
+def _image_loader(name: str, shape, num_classes: int, real_fn, size_kwarg=None):
     def load(cfg: DataConfig, **kwargs):
+        # Geometry-flexible datasets (federated ImageNet) take their edge
+        # size from the model kwargs so the config and the executed shapes
+        # always agree — a config saying image_size=224 runs 224, real or
+        # synthetic.
+        shp = tuple(shape)
+        if size_kwarg is not None and kwargs.get(size_kwarg):
+            s = int(kwargs[size_kwarg])
+            shp = (s, s, shape[-1])
         data_dir = os.path.expanduser(cfg.data_dir)
         real = real_fn(data_dir) if real_fn else None
         extra_meta = {}
@@ -120,10 +128,11 @@ def _image_loader(name: str, shape, num_classes: int, real_fn):
             else:
                 tx, ty, ex, ey = real
             source = "real"
+            shp = tuple(tx.shape[1:])
         elif cfg.synthetic_fallback:
             rng = np.random.default_rng(_stable_seed(name))
             templates = rng.uniform(
-                0.0, 1.0, size=(num_classes,) + tuple(shape)
+                0.0, 1.0, size=(num_classes,) + shp
             ).astype(np.float32)
             n_train = _scaled_train_size(cfg)
             tx, ty = _synthetic_images(rng, n_train, templates)
@@ -133,7 +142,7 @@ def _image_loader(name: str, shape, num_classes: int, real_fn):
             raise FileNotFoundError(
                 f"{name}: no data under {data_dir} and synthetic_fallback=False"
             )
-        meta = {"source": source, "input_shape": tuple(shape), **extra_meta}
+        meta = {"source": source, "input_shape": shp, **extra_meta}
         return tx, ty, ex, ey, meta, num_classes, "classify"
 
     return load
@@ -172,16 +181,65 @@ def _try_femnist_real(data_dir: str):
     return load_femnist(data_dir)
 
 
+def _try_imagenet_real(data_dir: str, test_fraction: float = 0.05):
+    """Federated ImageNet, directory-of-silos layout: ``data_dir/
+    imagenet_federated/silo_*.npz`` (each an institution's shard with
+    ``x`` [n,H,W,3] uint8/float and ``y`` [n] labels) plus an optional
+    ``test.npz``; without one, the last ~5% of each silo is held out.
+    Silo membership is returned as ``natural_groups`` so the ``silo``
+    partitioner preserves real institutional boundaries.
+    """
+    base = os.path.join(data_dir, "imagenet_federated")
+    if not os.path.isdir(base):
+        return None
+    silo_files = sorted(
+        f for f in os.listdir(base) if f.startswith("silo_") and f.endswith(".npz")
+    )
+    if not silo_files:
+        return None
+
+    def to_float(x):
+        return x.astype(np.float32) / 255.0 if x.dtype == np.uint8 else x.astype(np.float32)
+
+    test_path = os.path.join(base, "test.npz")
+    has_test = os.path.exists(test_path)
+    xs, ys, groups, test_xs, test_ys = [], [], [], [], []
+    offset = 0
+    for fname in silo_files:
+        with np.load(os.path.join(base, fname)) as d:
+            x, y = to_float(d["x"]), d["y"].astype(np.int32)
+        if not has_test and len(x) > 1:
+            n_test = max(1, int(len(x) * test_fraction))
+            test_xs.append(x[-n_test:])
+            test_ys.append(y[-n_test:])
+            x, y = x[:-n_test], y[:-n_test]
+        xs.append(x)
+        ys.append(y)
+        groups.append(np.arange(offset, offset + len(x), dtype=np.int64))
+        offset += len(x)
+    if has_test:
+        with np.load(test_path) as d:
+            ex, ey = to_float(d["x"]), d["y"].astype(np.int32)
+    else:
+        ex, ey = np.concatenate(test_xs), np.concatenate(test_ys)
+    return (
+        np.concatenate(xs), np.concatenate(ys), ex, ey,
+        {"natural_groups": groups},
+    )
+
+
 dataset_registry.register("mnist")(_image_loader("mnist", (28, 28, 1), 10, _try_mnist_real))
 dataset_registry.register("cifar10")(_image_loader("cifar10", (32, 32, 3), 10, _try_cifar10_real))
 dataset_registry.register("femnist")(
     _image_loader("femnist", (28, 28, 1), 62, _try_femnist_real)
 )
-# Federated ImageNet (cross-silo): synthetic stand-in uses a reduced 64×64
-# geometry by default to keep the sandbox runnable; the silo config overrides
-# image_size for real runs.
+# Federated ImageNet (cross-silo): geometry follows model.kwargs.image_size
+# (default 64 keeps the sandbox light); real silo files override everything.
 dataset_registry.register("imagenet_federated")(
-    _image_loader("imagenet_federated", (64, 64, 3), 1000, None)
+    _image_loader(
+        "imagenet_federated", (64, 64, 3), 1000, _try_imagenet_real,
+        size_kwarg="image_size",
+    )
 )
 
 

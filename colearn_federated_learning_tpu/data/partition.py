@@ -112,5 +112,8 @@ def partition(
                                        alpha=0.3, seed=seed)
         return natural_partition(natural_groups, num_clients, seed)
     if kind == "silo":
+        if natural_groups is not None:
+            # real cross-silo data: one institution == one natural group
+            return natural_partition(natural_groups, num_clients, seed)
         return silo_partition(n, num_clients, seed)
     raise ValueError(f"unknown partition kind {kind!r}")

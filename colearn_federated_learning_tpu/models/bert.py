@@ -12,11 +12,20 @@ long-sequence configs without touching the model.
 
 from __future__ import annotations
 
+from functools import partial
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+from typing import Callable
 
 from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
 from colearn_federated_learning_tpu.ops.attention import causal_attention
+from colearn_federated_learning_tpu.ops.ring_attention import (
+    blockwise_attention,
+    ring_attention,
+)
 
 
 class TransformerBlock(nn.Module):
@@ -24,18 +33,22 @@ class TransformerBlock(nn.Module):
     heads: int
     ff: int
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable = causal_attention  # (q, k, v, heads) → out
 
     @nn.compact
     def __call__(self, x):
-        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        qkv = nn.Dense(3 * self.hidden, dtype=self.compute_dtype)(h)
+        dense = partial(nn.Dense, dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        ln = partial(nn.LayerNorm, dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        h = ln()(x)
+        qkv = dense(3 * self.hidden)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        att = causal_attention(q, k, v, self.heads)
-        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(att)
-        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        h = nn.Dense(self.ff, dtype=self.compute_dtype)(h)
+        att = self.attention_fn(q, k, v, self.heads)
+        x = x + dense(self.hidden)(att)
+        h = ln()(x)
+        h = dense(self.ff)(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(h)
+        x = x + dense(self.hidden)(h)
         return x
 
 
@@ -47,29 +60,56 @@ class BertTinyLM(nn.Module):
     layers: int = 2
     ff: int = 512
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable = causal_attention
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
-        # tokens: [B, T] int32 → logits [B, T, V] (next-token prediction)
+    def __call__(self, tokens, train: bool = False, pos_offset=0):
+        # tokens: [B, T] int32 → logits [B, T, V] (next-token prediction).
+        # pos_offset: global position of tokens[:, 0] — nonzero only when
+        # the token axis is sharded (parallel/sequence.py), where each
+        # shard holds a block of a longer sequence.
         embed = nn.Embed(self.vocab_size, self.hidden,
-                         embedding_init=nn.initializers.normal(0.02))
+                         embedding_init=nn.initializers.normal(0.02),
+                         param_dtype=self.param_dtype)
         x = embed(tokens).astype(self.compute_dtype)
         pos = self.param("pos_embedding", nn.initializers.normal(0.02),
-                         (self.seq_len, self.hidden))
-        x = x + pos[None, : x.shape[1], :].astype(self.compute_dtype)
+                         (self.seq_len, self.hidden), self.param_dtype)
+        pos_block = jax.lax.dynamic_slice(
+            pos, (pos_offset, 0), (x.shape[1], self.hidden)
+        )
+        x = x + pos_block[None].astype(self.compute_dtype)
         for _ in range(self.layers):
-            x = TransformerBlock(self.hidden, self.heads, self.ff, self.compute_dtype)(x)
-        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+            x = TransformerBlock(self.hidden, self.heads, self.ff,
+                                 self.compute_dtype, self.param_dtype,
+                                 self.attention_fn)(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
         # weight-tied head
-        logits = embed.attend(x.astype(jnp.float32))
+        logits = embed.attend(x.astype(embed.embedding.dtype)).astype(jnp.float32)
         return logits
 
 
 @model_registry.register("bert_tiny")
 def _build(num_classes: int = 0, vocab_size: int = 90, seq_len: int = 80,
-           compute_dtype=jnp.float32, **_):
+           attention: str = "full", block_size: int = 128,
+           compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
     del num_classes  # LM: output dim == vocab_size
-    return BertTinyLM(vocab_size=vocab_size, seq_len=seq_len, compute_dtype=compute_dtype)
+    # attention backends (all exact, all causal):
+    #   full      — T×T scores on one chip (fine at LEAF scale)
+    #   blockwise — flash-style online-softmax scan of k/v blocks from
+    #               HBM; O(T·block) memory, the single-chip long-context path
+    #   ring      — sequence-parallel over the "seq" mesh axis; only valid
+    #               inside parallel/sequence.py's shard_map wrapper
+    if attention == "full":
+        attn = causal_attention
+    elif attention == "blockwise":
+        attn = partial(blockwise_attention, block_size=block_size, causal=True)
+    elif attention == "ring":
+        attn = partial(ring_attention, axis_name="seq", causal=True)
+    else:
+        raise ValueError(f"unknown attention backend {attention!r}")
+    return BertTinyLM(vocab_size=vocab_size, seq_len=seq_len, attention_fn=attn,
+                      compute_dtype=compute_dtype, param_dtype=param_dtype)
 
 
 def _lm_spec(vocab_size: int = 90, seq_len: int = 80, **_):

@@ -6,6 +6,8 @@ in the original. ~61k params — the CPU-smoke model.
 
 from __future__ import annotations
 
+from functools import partial
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -15,26 +17,30 @@ from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
 class LeNet5(nn.Module):
     num_classes: int = 10
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        dense = partial(nn.Dense, dtype=self.compute_dtype, param_dtype=self.param_dtype)
         x = x.astype(self.compute_dtype)
-        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = conv(6, (5, 5), padding="SAME")(x)
         x = nn.tanh(x)
         x = nn.avg_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.compute_dtype)(x)
+        x = conv(16, (5, 5), padding="VALID")(x)
         x = nn.tanh(x)
         x = nn.avg_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.tanh(nn.Dense(120, dtype=self.compute_dtype)(x))
-        x = nn.tanh(nn.Dense(84, dtype=self.compute_dtype)(x))
-        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        x = nn.tanh(dense(120)(x))
+        x = nn.tanh(dense(84)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=self.param_dtype)(x)
         return x
 
 
 @model_registry.register("lenet5")
-def _build(num_classes: int = 10, compute_dtype=jnp.float32, **_):
-    return LeNet5(num_classes=num_classes, compute_dtype=compute_dtype)
+def _build(num_classes: int = 10, compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
+    return LeNet5(num_classes=num_classes, compute_dtype=compute_dtype,
+                  param_dtype=param_dtype)
 
 
 _INPUT_SPECS["lenet5"] = ((28, 28, 1), jnp.float32)

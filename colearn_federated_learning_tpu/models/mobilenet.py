@@ -24,9 +24,9 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
-def _gn(ch: int, dtype):
+def _gn(ch: int, dtype, param_dtype=jnp.float32):
     # group count must divide channels; channels here are multiples of 8
-    return nn.GroupNorm(num_groups=min(8, ch), dtype=dtype)
+    return nn.GroupNorm(num_groups=min(8, ch), dtype=dtype, param_dtype=param_dtype)
 
 
 class InvertedResidual(nn.Module):
@@ -34,22 +34,24 @@ class InvertedResidual(nn.Module):
     strides: int
     expand: int
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype)
         in_ch = x.shape[-1]
         hidden = in_ch * self.expand
         y = x
         if self.expand != 1:
             y = conv(hidden, (1, 1))(y)
-            y = nn.relu6(_gn(hidden, self.compute_dtype)(y))
+            y = nn.relu6(_gn(hidden, self.compute_dtype, self.param_dtype)(y))
         # depthwise
         y = conv(hidden, (3, 3), strides=(self.strides, self.strides),
                  padding="SAME", feature_group_count=hidden)(y)
-        y = nn.relu6(_gn(hidden, self.compute_dtype)(y))
+        y = nn.relu6(_gn(hidden, self.compute_dtype, self.param_dtype)(y))
         y = conv(self.filters, (1, 1))(y)
-        y = _gn(self.filters, self.compute_dtype)(y)
+        y = _gn(self.filters, self.compute_dtype, self.param_dtype)(y)
         if self.strides == 1 and in_ch == self.filters:
             y = y + x
         return y
@@ -60,6 +62,7 @@ class MobileNetV2(nn.Module):
     width_mult: float = 1.0
     small_inputs: bool = True
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
     # (expand, filters, repeats, stride)
     blocks: Sequence[Tuple[int, int, int, int]] = (
         (1, 16, 1, 1),
@@ -77,8 +80,9 @@ class MobileNetV2(nn.Module):
         stem_stride = 1 if self.small_inputs else 2
         ch = _make_divisible(32 * self.width_mult)
         x = nn.Conv(ch, (3, 3), strides=(stem_stride, stem_stride), padding="SAME",
-                    use_bias=False, dtype=self.compute_dtype)(x)
-        x = nn.relu6(_gn(ch, self.compute_dtype)(x))
+                    use_bias=False, dtype=self.compute_dtype,
+                    param_dtype=self.param_dtype)(x)
+        x = nn.relu6(_gn(ch, self.compute_dtype, self.param_dtype)(x))
         for i, (t, c, n, s) in enumerate(self.blocks):
             filters = _make_divisible(c * self.width_mult)
             for b in range(n):
@@ -86,19 +90,22 @@ class MobileNetV2(nn.Module):
                 # avoid over-striding 28×28 inputs: drop the last two downsamples
                 if self.small_inputs and i >= 5:
                     stride = 1
-                x = InvertedResidual(filters, stride, t, self.compute_dtype)(x)
+                x = InvertedResidual(filters, stride, t, self.compute_dtype, self.param_dtype)(x)
         head = _make_divisible(1280 * max(1.0, self.width_mult))
-        x = nn.Conv(head, (1, 1), use_bias=False, dtype=self.compute_dtype)(x)
-        x = nn.relu6(_gn(head, self.compute_dtype)(x))
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=self.compute_dtype,
+                    param_dtype=self.param_dtype)(x)
+        x = nn.relu6(_gn(head, self.compute_dtype, self.param_dtype)(x))
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype)(x)
 
 
 @model_registry.register("mobilenetv2")
 def _build(num_classes: int = 62, width_mult: float = 1.0, small_inputs: bool = True,
-           compute_dtype=jnp.float32, **_):
+           compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
     return MobileNetV2(num_classes=num_classes, width_mult=width_mult,
-                       small_inputs=small_inputs, compute_dtype=compute_dtype)
+                       small_inputs=small_inputs, compute_dtype=compute_dtype,
+                       param_dtype=param_dtype)
 
 
 _INPUT_SPECS["mobilenetv2"] = ((28, 28, 1), jnp.float32)

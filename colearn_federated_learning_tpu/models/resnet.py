@@ -27,11 +27,14 @@ class ResNetBlock(nn.Module):
     filters: int
     strides: int = 1
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
-        norm = partial(nn.GroupNorm, num_groups=min(32, self.filters), dtype=self.compute_dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype)
+        norm = partial(nn.GroupNorm, num_groups=min(32, self.filters),
+                       dtype=self.compute_dtype, param_dtype=self.param_dtype)
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
         y = nn.relu(norm()(y))
@@ -49,30 +52,38 @@ class ResNet18(nn.Module):
     width: int = 64
     small_inputs: bool = True
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype)
         x = x.astype(self.compute_dtype)
         if self.small_inputs:
-            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False, dtype=self.compute_dtype)(x)
+            x = conv(self.width, (3, 3), padding="SAME")(x)
         else:
-            x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                        use_bias=False, dtype=self.compute_dtype)(x)
-        x = nn.relu(nn.GroupNorm(num_groups=32, dtype=self.compute_dtype)(x))
+            x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)])(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(32, self.width), dtype=self.compute_dtype,
+                                 param_dtype=self.param_dtype)(x))
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             filters = self.width * (2**i)
             for b in range(n_blocks):
                 strides = 2 if (i > 0 and b == 0) else 1
-                x = ResNetBlock(filters, strides, self.compute_dtype)(x)
+                x = ResNetBlock(filters, strides, self.compute_dtype, self.param_dtype)(x)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype)(x)
 
 
 @model_registry.register("resnet18")
-def _build(num_classes: int = 10, small_inputs: bool = True, compute_dtype=jnp.float32, **_):
-    return ResNet18(num_classes=num_classes, small_inputs=small_inputs, compute_dtype=compute_dtype)
+def _build(num_classes: int = 10, small_inputs: bool = True, width: int = 64,
+           compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
+    # width is overridable so tests can shrink the model while exercising
+    # the identical blocks/stages/GroupNorm code path
+    return ResNet18(num_classes=num_classes, small_inputs=small_inputs, width=width,
+                    compute_dtype=compute_dtype, param_dtype=param_dtype)
 
 
 _INPUT_SPECS["resnet18"] = ((32, 32, 3), jnp.float32)

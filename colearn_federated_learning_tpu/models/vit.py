@@ -8,6 +8,8 @@ cross-silo FedAvg/DP path aggregates everything uniformly.
 
 from __future__ import annotations
 
+from functools import partial
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -20,17 +22,20 @@ class ViTBlock(nn.Module):
     heads: int
     mlp_dim: int
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        qkv = nn.Dense(3 * self.hidden, dtype=self.compute_dtype)(h)
+        dense = partial(nn.Dense, dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        ln = partial(nn.LayerNorm, dtype=self.compute_dtype, param_dtype=self.param_dtype)
+        h = ln()(x)
+        qkv = dense(3 * self.hidden)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         att = full_attention(q, k, v, self.heads)
-        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(att)
-        h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        h = nn.gelu(nn.Dense(self.mlp_dim, dtype=self.compute_dtype)(h))
-        x = x + nn.Dense(self.hidden, dtype=self.compute_dtype)(h)
+        x = x + dense(self.hidden)(att)
+        h = ln()(x)
+        h = nn.gelu(dense(self.mlp_dim)(h))
+        x = x + dense(self.hidden)(h)
         return x
 
 
@@ -43,29 +48,45 @@ class ViT(nn.Module):
     heads: int = 12
     mlp_dim: int = 3072
     compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if x.shape[1] != self.image_size or x.shape[2] != self.image_size:
+            raise ValueError(
+                f"ViT(image_size={self.image_size}) got input {x.shape[1:3]}; "
+                "config geometry and data geometry must agree"
+            )
         x = x.astype(self.compute_dtype)
         x = nn.Conv(self.hidden, (self.patch_size, self.patch_size),
                     strides=(self.patch_size, self.patch_size),
-                    padding="VALID", dtype=self.compute_dtype)(x)
+                    padding="VALID", dtype=self.compute_dtype,
+                    param_dtype=self.param_dtype)(x)
         b, h, w, c = x.shape
         x = x.reshape(b, h * w, c)
-        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.hidden))
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.hidden),
+                         self.param_dtype)
         x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden)).astype(x.dtype), x], axis=1)
         pos = self.param("pos_embedding", nn.initializers.normal(0.02),
-                         (1, x.shape[1], self.hidden))
+                         (1, x.shape[1], self.hidden), self.param_dtype)
         x = x + pos.astype(x.dtype)
         for _ in range(self.layers):
-            x = ViTBlock(self.hidden, self.heads, self.mlp_dim, self.compute_dtype)(x)
-        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        return nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+            x = ViTBlock(self.hidden, self.heads, self.mlp_dim,
+                         self.compute_dtype, self.param_dtype)(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype)(x[:, 0])
 
 
 @model_registry.register("vit_b16")
-def _build(num_classes: int = 1000, image_size: int = 224, compute_dtype=jnp.float32, **_):
-    return ViT(num_classes=num_classes, image_size=image_size, compute_dtype=compute_dtype)
+def _build(num_classes: int = 1000, image_size: int = 224, patch_size: int = 16,
+           hidden: int = 768, layers: int = 12, heads: int = 12, mlp_dim: int = 3072,
+           compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
+    # geometry kwargs are overridable so tests/small studies can shrink the
+    # model while exercising the identical DP+silo code path
+    return ViT(num_classes=num_classes, image_size=image_size, patch_size=patch_size,
+               hidden=hidden, layers=layers, heads=heads, mlp_dim=mlp_dim,
+               compute_dtype=compute_dtype, param_dtype=param_dtype)
 
 
 def _vit_spec(image_size: int = 224, **_):

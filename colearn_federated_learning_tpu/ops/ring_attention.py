@@ -83,9 +83,13 @@ def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
         vh_next = jax.lax.ppermute(vh_cur, axis_name, perm)
         return (o_new, m_new, l_new, kh_next, vh_next), None
 
-    o0 = jnp.zeros((b, h, t_loc, hd), jnp.float32)
-    m0 = jnp.full((b, h, t_loc), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    # +0·Σq ties the accumulators' device-varying type to the data, so the
+    # scan carry type-checks inside any enclosing shard_map (seq-sharded
+    # here, and also the clients axis when nested in the round engine)
+    zero = (0.0 * qh.sum()).astype(jnp.float32)
+    o0 = jnp.zeros((b, h, t_loc, hd), jnp.float32) + zero
+    m0 = jnp.full((b, h, t_loc), _NEG_BIG, jnp.float32) + zero
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32) + zero
     (o, m, l, _, _), _ = jax.lax.scan(
         body, (o0, m0, l0, kh, vh), jnp.arange(n)
     )
@@ -129,9 +133,10 @@ def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = Tru
         ).astype(jnp.float32)
         return (o_new, m_new, l_new), None
 
-    o0 = jnp.zeros((b, h, t, hd), jnp.float32)
-    m0 = jnp.full((b, h, t), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
+    zero = (0.0 * qh.sum()).astype(jnp.float32)  # see ring_attention
+    o0 = jnp.zeros((b, h, t, hd), jnp.float32) + zero
+    m0 = jnp.full((b, h, t), _NEG_BIG, jnp.float32) + zero
+    l0 = jnp.zeros((b, h, t), jnp.float32) + zero
     (o, m, l), _ = jax.lax.scan(
         body,
         (o0, m0, l0),

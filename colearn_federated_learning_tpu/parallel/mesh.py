@@ -17,6 +17,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "clients"
+BATCH_AXIS = "batch"
 
 
 def largest_lane_count(cohort_size: int, n_devices: int) -> int:
@@ -31,13 +32,30 @@ def largest_lane_count(cohort_size: int, n_devices: int) -> int:
     return 1
 
 
-def build_client_mesh(num_lanes: int = 0, devices=None) -> Mesh:
+def build_client_mesh(num_lanes: int = 0, devices=None, batch_shards: int = 1) -> Mesh:
+    """``batch_shards > 1`` adds the second mesh axis (SURVEY.md §2
+    "parallelism strategies" axis 2): each virtual-client lane spans
+    ``batch_shards`` chips that data-parallel one client's minibatch —
+    for silo models whose per-client step outgrows a single chip."""
     devices = list(devices if devices is not None else jax.devices())
     if num_lanes <= 0:
-        num_lanes = len(devices)
-    if num_lanes > len(devices):
-        raise ValueError(f"num_lanes {num_lanes} > visible devices {len(devices)}")
-    return Mesh(np.array(devices[:num_lanes]), (CLIENT_AXIS,))
+        num_lanes = len(devices) // batch_shards
+    need = num_lanes * batch_shards
+    if need > len(devices):
+        raise ValueError(
+            f"{num_lanes} lanes × {batch_shards} batch shards > visible devices "
+            f"{len(devices)}"
+        )
+    if batch_shards == 1:
+        return Mesh(np.array(devices[:need]), (CLIENT_AXIS,))
+    return Mesh(
+        np.array(devices[:need]).reshape(num_lanes, batch_shards),
+        (CLIENT_AXIS, BATCH_AXIS),
+    )
+
+
+def has_batch_axis(mesh: Mesh) -> bool:
+    return BATCH_AXIS in mesh.shape
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -45,5 +63,13 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def client_sharded(mesh: Mesh) -> NamedSharding:
-    """Shard leading (cohort) axis across lanes."""
+    """Shard leading (cohort) axis across lanes; replicate over batch shards."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def cohort_sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for the [K, steps, batch] index/mask tensors: cohort over
+    lanes and, when present, the batch dim over batch shards."""
+    if has_batch_axis(mesh):
+        return NamedSharding(mesh, P(CLIENT_AXIS, None, BATCH_AXIS))
     return NamedSharding(mesh, P(CLIENT_AXIS))

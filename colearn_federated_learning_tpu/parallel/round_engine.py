@@ -28,7 +28,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from colearn_federated_learning_tpu.client.trainer import make_local_train_fn
-from colearn_federated_learning_tpu.parallel.mesh import CLIENT_AXIS
+from colearn_federated_learning_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    CLIENT_AXIS,
+    has_batch_axis,
+)
 from colearn_federated_learning_tpu.utils import trees
 
 
@@ -70,7 +74,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     with width (one activation set per vmapped client), so big-model
     configs keep it low.
     """
-    local_train = make_local_train_fn(model, client_cfg, dp_cfg, task)
+    batch_sharded = has_batch_axis(mesh)
+    if batch_sharded and client_cfg.batch_size % mesh.shape[BATCH_AXIS]:
+        raise ValueError(
+            f"batch_size {client_cfg.batch_size} not divisible by "
+            f"{mesh.shape[BATCH_AXIS]} batch shards"
+        )
+    local_train = make_local_train_fn(
+        model, client_cfg, dp_cfg, task,
+        batch_axis=BATCH_AXIS if batch_sharded else None,
+    )
     n_lanes = mesh.shape[CLIENT_AXIS]
     if cohort_size % n_lanes != 0:
         raise ValueError(f"cohort {cohort_size} not divisible by lanes {n_lanes}")
@@ -122,10 +135,15 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         mean_delta = trees.tree_scale(d_sum, 1.0 / denom)
         return mean_delta, n_sum, l_sum / denom
 
+    # [K, steps, batch] index/mask tensors additionally shard the batch
+    # dim over the batch axis when present; n_ex/keys stay per-client.
+    cohort_spec = (
+        P(CLIENT_AXIS, None, BATCH_AXIS) if batch_sharded else P(CLIENT_AXIS)
+    )
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        in_specs=(P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS)),
         out_specs=(P(), P(), P()),
     )
 

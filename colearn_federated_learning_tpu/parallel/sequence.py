@@ -1,0 +1,84 @@
+"""Sequence/context parallelism: long-context support over a device mesh.
+
+Long-context is first-class in this framework even though the reference's
+own models are short-sequence (SURVEY.md §5 records that): the token axis
+is sharded over a ``"seq"`` mesh axis, pointwise layers (embed, LayerNorm,
+MLP) run locally on each shard, and exact attention is computed by
+``ops.ring_attention`` — k/v blocks rotate around the ring via
+``ppermute`` riding the ICI, an online-softmax accumulating exact results
+with O(T/n) memory per device (Liu et al. 2023's recipe, independently
+implemented).
+
+Two entry points:
+
+- :func:`make_ring_attention_fn` — an attention callable for model
+  builders (``build_model("bert_tiny", attention="ring")``); only valid
+  when the model is applied inside a ``shard_map`` whose mesh carries the
+  sequence axis.
+- :func:`make_seq_parallel_lm_forward` — the full seq-sharded transformer
+  forward: wraps ``model.apply`` in ``shard_map`` with tokens sharded
+  ``[B, T/n]`` per device and global position offsets threaded through,
+  so callers just hand it ``(params, tokens)`` like the plain forward.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from colearn_federated_learning_tpu.ops.ring_attention import ring_attention
+
+SEQ_AXIS = "seq"
+
+
+def build_seq_mesh(n_devices: int = 0, devices=None, axis: str = SEQ_AXIS) -> Mesh:
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices <= 0:
+        n_devices = len(devices)
+    return Mesh(np.array(devices[:n_devices]), (axis,))
+
+
+def make_ring_attention_fn(axis: str = SEQ_AXIS, causal: bool = True):
+    """Attention callable ``(q, k, v, heads) → out`` running the ring
+    protocol over mesh axis ``axis``. Must execute inside a shard_map
+    that carries that axis with the token dim sharded over it."""
+    return partial(ring_attention, axis_name=axis, causal=causal)
+
+
+def make_seq_parallel_lm_forward(model, mesh: Mesh, axis: str = SEQ_AXIS,
+                                 train: bool = False):
+    """Jitted seq-sharded forward: ``(params, tokens [B, T]) → logits``.
+
+    ``model`` must (a) use ring attention on ``axis`` (build with
+    ``attention="ring"``) and (b) accept a ``pos_offset`` argument so
+    each shard indexes its *global* positions into the positional table.
+    ``T`` must be divisible by the mesh's seq-axis size.
+    """
+    n = mesh.shape[axis]
+
+    def shard_fwd(params, tokens):
+        # tokens: [B, T/n] — this device's token block
+        offset = jax.lax.axis_index(axis) * tokens.shape[1]
+        return model.apply({"params": params}, tokens, train=train,
+                           pos_offset=offset)
+
+    sharded = jax.shard_map(
+        shard_fwd,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis)),
+        out_specs=P(None, axis, None),
+    )
+
+    @jax.jit
+    def forward(params, tokens):
+        if tokens.shape[1] % n:
+            raise ValueError(
+                f"seq len {tokens.shape[1]} not divisible by {n} seq lanes"
+            )
+        return sharded(params, tokens)
+
+    return forward

@@ -21,12 +21,19 @@ from colearn_federated_learning_tpu.config import DPConfig
 from colearn_federated_learning_tpu.utils import trees
 
 
-def make_dp_grad_fn(loss_fn, cfg: DPConfig):
+def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
     """Wrap a masked-mean loss into a DP-SGD gradient estimator.
 
     loss_fn(params, x, y, m) must be a mean over the mask — internally we
     re-call it per example with a singleton mask so the per-example
     gradient is the plain example gradient.
+
+    ``batch_axis``: when each client's batch is sharded over a mesh axis
+    (mesh.py ``BATCH_AXIS``), per-shard clipped-grad sums are psummed
+    before noising; the noise key is per-client (replicated over batch
+    shards), so every shard adds the identical noise draw to the
+    identical post-psum sum — one noise application, exactly as in the
+    unsharded mechanism.
     """
 
     def single_example_grad(params, x1, y1):
@@ -37,6 +44,15 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig):
         return loss, grads
 
     def dp_grads(params, x, y, m, rng):
+        if batch_axis is not None:
+            # cast params batch-varying so per-example cotangents stay
+            # LOCAL — clipping must see single-example grads, and the
+            # auto-psum AD inserts for invariant params would otherwise
+            # sum corresponding examples across shards before the clip
+            # (see client/trainer.py _batch_varying)
+            params = jax.tree.map(
+                lambda p: jax.lax.pcast(p, (batch_axis,), to="varying"), params
+            )
         b = x.shape[0]
         mb = max(1, min(cfg.microbatch_size, b))
         n_micro = b // mb
@@ -73,7 +89,12 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig):
         (g_sum, loss_sum), _ = jax.lax.scan(
             micro_step, (zero, zero_scalar), (xm, ym, mm)
         )
-        denom = jnp.maximum(m.sum(), 1.0)
+        n = m.sum()
+        if batch_axis is not None:
+            g_sum = jax.tree.map(lambda g: jax.lax.psum(g, batch_axis), g_sum)
+            loss_sum = jax.lax.psum(loss_sum, batch_axis)
+            n = jax.lax.psum(n, batch_axis)
+        denom = jnp.maximum(n, 1.0)
         keys = jax.random.split(rng, len(jax.tree.leaves(params)))
         keys = jax.tree.unflatten(jax.tree.structure(params), list(keys))
         sigma = cfg.noise_multiplier * cfg.l2_clip
@@ -87,25 +108,64 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig):
     return dp_grads
 
 
+_DEFAULT_ORDERS = tuple(range(2, 65)) + (80, 96, 128, 192, 256, 512)
+
+
+def _log_comb(n: int, k: int) -> float:
+    import math
+
+    return math.lgamma(n + 1) - math.lgamma(k + 1) - math.lgamma(n - k + 1)
+
+
+def sampled_gaussian_rdp(q: float, sigma: float, alpha: int) -> float:
+    """Exact RDP of the Poisson-sampled Gaussian mechanism at integer
+    order ``alpha`` ≥ 2 (Mironov, Talwar & Zhang 2019, eq. for integer α):
+
+        RDP(α) = 1/(α−1) · log Σ_{k=0}^{α} C(α,k)(1−q)^{α−k} q^k
+                                  · exp(k(k−1)/(2σ²))
+
+    This is the same closed form TF-Privacy/Opacus use for integer
+    orders; no heuristic validity window, exact for all (q, σ).
+    """
+    import math
+
+    if q == 0.0:
+        return 0.0
+    if q >= 1.0:
+        return alpha / (2.0 * sigma * sigma)
+    # log-sum-exp over k of: logC(α,k) + (α−k)·log(1−q) + k·log q + k(k−1)/(2σ²)
+    log_terms = [
+        _log_comb(alpha, k)
+        + (alpha - k) * math.log1p(-q)
+        + (k * math.log(q) if k else 0.0)
+        + k * (k - 1) / (2.0 * sigma * sigma)
+        for k in range(alpha + 1)
+    ]
+    m = max(log_terms)
+    lse = m + math.log(sum(math.exp(t - m) for t in log_terms))
+    return max(0.0, lse) / (alpha - 1)
+
+
 def rdp_epsilon(
     noise_multiplier: float,
     sampling_rate: float,
     steps: int,
     delta: float,
-    orders=tuple([1.25, 1.5, 1.75, 2.0, 2.5, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0,
-                  12.0, 16.0, 20.0, 24.0, 32.0, 48.0, 64.0]),
+    orders=_DEFAULT_ORDERS,
 ) -> float:
-    """Moments/RDP accountant for the subsampled Gaussian mechanism.
+    """(ε, δ)-DP spent after ``steps`` runs of the sampled Gaussian
+    mechanism: exact integer-order RDP composed linearly, converted with
+    the standard ε = T·RDP(α) + log(1/δ)/(α−1), minimized over orders.
 
-    Per-order RDP bound, composed over ``steps`` and converted to (ε, δ):
-
-    - amplified bound ``RDP(α) ≤ q²·α/σ²`` (Abadi et al. moments bound)
-      only where it is valid — ``α ≤ σ²·log(1/(q·σ))`` and ``σ ≥ 1`` —
-    - otherwise the always-valid unamplified Gaussian bound
-      ``RDP(α) = α/(2σ²)`` (subsampling can only help, never hurt).
-
-    Conservative but sound for reporting; a tighter accountant can swap
-    in later without touching callers.
+    Accounting caveats (callers must report them, not bury them):
+    - The amplification model is **Poisson subsampling**; this codebase's
+      loader takes shuffled permutation passes over each client shard.
+      Reporting amplified ε for shuffle-based batches is the standard
+      DP-SGD convention (Abadi et al. and successors) but is an
+      approximation, not a theorem, for this sampling scheme.
+    - ``sampling_rate`` must be an upper bound on every participating
+      client's batch/shard ratio (use the minimum shard size, not the
+      average) or small-shard clients' spend is under-reported.
     """
     import math
 
@@ -113,16 +173,10 @@ def rdp_epsilon(
         return float("inf")
     q = min(1.0, sampling_rate)
     sigma = noise_multiplier
-    if q * sigma < 1.0 and sigma >= 1.0:
-        alpha_max = sigma * sigma * math.log(1.0 / (q * sigma))
-    else:
-        alpha_max = 0.0  # amplified bound never valid
     best = float("inf")
     for alpha in orders:
-        if alpha <= alpha_max:
-            rdp_per_step = (q * q * alpha) / (sigma * sigma)
-        else:
-            rdp_per_step = alpha / (2.0 * sigma * sigma)
-        eps = steps * rdp_per_step + math.log(1.0 / delta) / (alpha - 1.0)
+        eps = steps * sampled_gaussian_rdp(q, sigma, alpha) + math.log(1.0 / delta) / (
+            alpha - 1
+        )
         best = min(best, eps)
     return best

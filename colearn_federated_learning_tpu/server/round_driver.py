@@ -50,7 +50,9 @@ class Experiment:
         compute_dtype = _DTYPES[cfg.run.compute_dtype]
         self.model = build_model(
             cfg.model.name, cfg.model.num_classes,
-            compute_dtype=compute_dtype, **cfg.model.kwargs
+            compute_dtype=compute_dtype,
+            param_dtype=_DTYPES[cfg.run.param_dtype],
+            **cfg.model.kwargs,
         )
         self.fed = build_federated_data(cfg.data, seed=cfg.run.seed, **cfg.model.kwargs)
         self.task = self.fed.task
@@ -61,6 +63,18 @@ class Experiment:
         self.server_opt_init, server_update = make_server_update_fn(cfg.server)
 
         if cfg.run.engine == "sharded":
+            batch_shards = max(1, cfg.run.batch_shards)
+            if cfg.client.batch_size % batch_shards:
+                raise ValueError(
+                    f"run.batch_shards={batch_shards} must divide "
+                    f"client.batch_size={cfg.client.batch_size}"
+                )
+            avail = len(jax.devices()) // batch_shards
+            if avail < 1:
+                raise ValueError(
+                    f"run.batch_shards={batch_shards} > visible devices "
+                    f"{len(jax.devices())}"
+                )
             if cfg.run.num_lanes:
                 lanes = cfg.run.num_lanes
                 if cfg.server.cohort_size % lanes != 0:
@@ -69,18 +83,17 @@ class Experiment:
                         f"{cfg.server.cohort_size} (set num_lanes=0 to auto-pick)"
                     )
             else:
-                lanes = mesh_lib.largest_lane_count(
-                    cfg.server.cohort_size, len(jax.devices())
-                )
-            self.mesh = mesh_lib.build_client_mesh(lanes)
+                lanes = mesh_lib.largest_lane_count(cfg.server.cohort_size, avail)
+            self.mesh = mesh_lib.build_client_mesh(lanes, batch_shards=batch_shards)
             self.round_fn = make_sharded_round_fn(
                 self.model, cfg.client, cfg.dp, self.task, self.mesh,
                 server_update, cfg.server.cohort_size,
                 client_vmap_width=cfg.run.client_vmap_width,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
-            self._cohort_sharding = mesh_lib.client_sharded(self.mesh)
-            self.n_chips = lanes
+            self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
+            self._client_sharding = mesh_lib.client_sharded(self.mesh)
+            self.n_chips = lanes * batch_shards
         else:
             self.mesh = None
             self.round_fn = make_sequential_round_fn(
@@ -88,10 +101,13 @@ class Experiment:
             )
             self._data_sharding = None
             self._cohort_sharding = None
+            self._client_sharding = None
             self.n_chips = 1
 
-        # dataset bytes go to HBM exactly once (replicated over lanes)
-        put = (lambda a: jax.device_put(a, self._data_sharding)) if self._data_sharding else jax.device_put
+        # dataset bytes go to HBM exactly once (replicated over lanes);
+        # multi-host runs assemble global arrays from the host-replicated
+        # copies instead of device_put-ing across processes
+        put = self._put_data
         self.train_x = put(jnp.asarray(self.fed.train_x))
         self.train_y = put(jnp.asarray(self.fed.train_y))
         self._eval_fn = jax.jit(make_eval_fn(self.model, self.task))
@@ -104,6 +120,20 @@ class Experiment:
                                     append=cfg.run.resume)
 
     # ------------------------------------------------------------------
+
+    def _put(self, arr, sharding):
+        if sharding is None:
+            return jax.device_put(arr)
+        if jax.process_count() > 1:
+            from colearn_federated_learning_tpu.parallel.distributed import (
+                host_local_array,
+            )
+
+            return host_local_array(arr, sharding)
+        return jax.device_put(arr, sharding)
+
+    def _put_data(self, arr):
+        return self._put(arr, self._data_sharding)
 
     def init_state(self, seed: Optional[int] = None) -> Dict[str, Any]:
         seed = self.cfg.run.seed if seed is None else seed
@@ -122,10 +152,8 @@ class Experiment:
     def _place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
         """Replicate params/opt state over the mesh (fresh init or restore)."""
         if self._data_sharding is not None:
-            state["params"] = jax.device_put(state["params"], self._data_sharding)
-            state["server_opt_state"] = jax.device_put(
-                state["server_opt_state"], self._data_sharding
-            )
+            state["params"] = self._put_data(state["params"])
+            state["server_opt_state"] = self._put_data(state["server_opt_state"])
         return state
 
     def _round_inputs(self, round_idx: int):
@@ -141,9 +169,9 @@ class Experiment:
                 participate[host_rng.integers(len(cohort))] = True
             n_ex = n_ex * participate.astype(np.float32)
         if self._cohort_sharding is not None:
-            idx = jax.device_put(idx, self._cohort_sharding)
-            mask = jax.device_put(mask, self._cohort_sharding)
-            n_ex = jax.device_put(n_ex, self._cohort_sharding)
+            idx = self._put(idx, self._cohort_sharding)
+            mask = self._put(mask, self._cohort_sharding)
+            n_ex = self._put(n_ex, self._client_sharding)
         return cohort, idx, mask, n_ex
 
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
@@ -262,13 +290,18 @@ class Experiment:
     # ------------------------------------------------------------------
 
     def dp_epsilon(self, rounds_done: int) -> float:
-        """(ε, δ) spent so far: example-level DP-SGD accounting with
-        sampling rate = batch / avg participating-client shard size,
-        composed over every local step executed across rounds."""
+        """(ε, δ) spent so far: example-level DP-SGD accounting composed
+        over every local step executed across rounds.
+
+        The sampling rate uses the **minimum** client shard size (the
+        worst case over participants), so the reported ε upper-bounds
+        every client's spend. See privacy/dp.py for the Poisson-vs-
+        shuffle accounting caveat.
+        """
         from colearn_federated_learning_tpu.privacy.dp import rdp_epsilon
 
-        avg_shard = float(self.fed.client_sizes().mean())
-        q = min(1.0, self.cfg.client.batch_size / max(avg_shard, 1.0))
+        min_shard = float(min(self.shape.cap, int(self.fed.client_sizes().min())))
+        q = min(1.0, self.cfg.client.batch_size / max(min_shard, 1.0))
         total_steps = rounds_done * self.shape.steps
         return rdp_epsilon(
             self.cfg.dp.noise_multiplier, q, total_steps, self.cfg.dp.delta

@@ -1,0 +1,89 @@
+"""Worker for the 2-process loopback multihost test (SURVEY.md §3.5).
+
+Each process: 4 fake CPU devices → 8 global devices, gloo cross-process
+collectives, one sharded federated round over the global clients mesh.
+Prints the round loss; the parent asserts both processes agree with the
+sequential oracle. Run: multihost_worker.py <pid> <nprocs> <port>.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from colearn_federated_learning_tpu.parallel.distributed import (
+        host_local_array,
+        initialize,
+    )
+
+    initialize(f"127.0.0.1:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == 4 * nprocs, jax.device_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colearn_federated_learning_tpu.config import ClientConfig, DPConfig, ServerConfig
+    from colearn_federated_learning_tpu.models import build_model, init_params
+    from colearn_federated_learning_tpu.parallel.mesh import (
+        build_client_mesh,
+        client_sharded,
+        cohort_sharded,
+        replicated,
+    )
+    from colearn_federated_learning_tpu.parallel.round_engine import make_sharded_round_fn
+    from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+
+    # identical deterministic inputs on every host
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    n, cohort, steps, batch = 64, 8, 2, 4
+    train_x = rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32)
+    train_y = rng.integers(0, 10, n).astype(np.int32)
+    idx = rng.integers(0, n, (cohort, steps, batch)).astype(np.int32)
+    mask = np.ones((cohort, steps, batch), np.float32)
+    n_ex = np.full((cohort,), float(steps * batch), np.float32)
+
+    mesh = build_client_mesh(8)  # spans both processes
+    ccfg = ClientConfig(local_epochs=1, batch_size=batch, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=cohort)
+    server_init, server_update = make_server_update_fn(scfg)
+    round_fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=cohort, donate=False,
+    )
+
+    put_rep = lambda a: host_local_array(a, replicated(mesh))
+    new_params, _, metrics = round_fn(
+        put_rep(params),
+        put_rep(server_init(params)),
+        put_rep(train_x),
+        put_rep(train_y),
+        host_local_array(idx, cohort_sharded(mesh)),
+        host_local_array(mask, cohort_sharded(mesh)),
+        host_local_array(n_ex, client_sharded(mesh)),
+        put_rep(np.asarray(jax.random.PRNGKey(7))),
+    )
+    jax.block_until_ready(new_params)
+    first_leaf = jax.tree.leaves(new_params)[0]
+    print(
+        f"MULTIHOST_OK pid={pid} loss={float(metrics.train_loss):.6f} "
+        f"examples={float(metrics.examples):.1f} "
+        f"leaf0={float(jnp.asarray(first_leaf).reshape(-1)[0]):.6f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

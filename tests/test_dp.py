@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from colearn_federated_learning_tpu.config import DPConfig
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
@@ -65,3 +66,69 @@ def test_rdp_accountant_monotonic():
     assert e2 > e1
     assert e3 < e2
     assert dp_lib.rdp_epsilon(0.0, 0.01, 10, 1e-5) == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# accountant validation (VERDICT r1 next-#7): the integer-order
+# sampled-Gaussian RDP closed form is checked against an independent
+# numerical-integration oracle, the analytic unamplified Gaussian case,
+# and a published-literature ballpark.
+# ---------------------------------------------------------------------------
+
+
+def _numeric_renyi_sampled_gaussian(q, sigma, alpha, grid=400_000, span=60.0):
+    """Oracle: D_α(mix‖p0) and D_α(p0‖mix) for mix=(1−q)N(0,σ²)+qN(1,σ²),
+    by direct quadrature of ∫ P^α Q^{1−α}. Independent of the closed form."""
+    x = np.linspace(-span, span, grid)
+    lp0 = -0.5 * ((x / sigma) ** 2) - np.log(sigma * np.sqrt(2 * np.pi))
+    lp1 = -0.5 * (((x - 1.0) / sigma) ** 2) - np.log(sigma * np.sqrt(2 * np.pi))
+    lmix = np.logaddexp(np.log1p(-q) + lp0, np.log(q) + lp1)
+
+    def d_renyi(lP, lQ):
+        log_integrand = alpha * lP + (1.0 - alpha) * lQ
+        shift = log_integrand.max()  # keep exp() in float64 range at high α
+        val = np.trapezoid(np.exp(log_integrand - shift), x)
+        return (shift + np.log(val)) / (alpha - 1.0)
+
+    return d_renyi(lmix, lp0), d_renyi(lp0, lmix)
+
+
+@pytest.mark.parametrize("q,sigma", [(0.01, 1.1), (0.1, 1.0), (0.5, 2.0), (0.02, 0.7)])
+@pytest.mark.parametrize("alpha", [2, 3, 8, 32])
+def test_sampled_gaussian_rdp_matches_numeric_oracle(q, sigma, alpha):
+    closed = dp_lib.sampled_gaussian_rdp(q, sigma, alpha)
+    d_mix_p0, d_p0_mix = _numeric_renyi_sampled_gaussian(q, sigma, alpha)
+    # exact match for the computed direction...
+    np.testing.assert_allclose(closed, d_mix_p0, rtol=1e-5, atol=1e-9)
+    # ...and that direction dominates (Mironov et al. 2019 §3.3), so it is
+    # the correct per-step RDP for add/remove adjacency
+    assert closed >= d_p0_mix - 1e-7
+
+
+def test_rdp_accountant_unamplified_analytic():
+    """q=1, T=1: ε = min_α α/(2σ²) + log(1/δ)/(α−1); the continuous optimum
+    is 1/(2σ²) + √(2·log(1/δ))/σ (Mironov 2017 Prop. 3 + conversion).
+    Integer orders can only be ≥ the continuum value, and close to it."""
+    import math
+
+    sigma, delta = 1.0, 1e-5
+    analytic = 1 / (2 * sigma**2) + math.sqrt(2 * math.log(1 / delta)) / sigma
+    got = dp_lib.rdp_epsilon(sigma, 1.0, 1, delta)
+    assert analytic <= got <= analytic * 1.02, (got, analytic)
+
+
+def test_rdp_accountant_literature_value():
+    """The headline number of Abadi et al. 2016 (§1/Fig. 2): q=0.01,
+    σ=4, T=10⁴ steps, δ=1e-5 — the moments accountant reports ε ≈ 1.26
+    (vs ≈9.34 for strong composition). Our exact integer-order RDP
+    accountant must land in a tight band around it."""
+    eps = dp_lib.rdp_epsilon(4.0, 0.01, 10_000, 1e-5)
+    assert 1.2 < eps < 1.35, eps
+
+
+def test_rdp_accountant_subsampling_never_hurts():
+    """Amplified ε at q<1 must beat the unamplified Gaussian bound."""
+    for q in (0.001, 0.01, 0.1, 0.9):
+        amp = dp_lib.rdp_epsilon(1.5, q, 500, 1e-5)
+        unamp = dp_lib.rdp_epsilon(1.5, 1.0, 500, 1e-5)
+        assert amp <= unamp + 1e-9, (q, amp, unamp)
