@@ -25,27 +25,38 @@ WARMUP_ROUNDS = 2
 TIMED_ROUNDS = 8
 
 # Dense bf16 peak of one TPU v5e (v5 lite) chip. MFU = achieved/peak; the
-# count comes from XLA's own cost model of the compiled round program, so
-# it tracks the program as built (fwd+bwd, all 128 client-steps, psum).
+# FLOP count comes from XLA's cost model of ONE scan-free train step
+# (fwd+bwd on one batch) × steps × cohort — see _round_flops for why the
+# whole-round program can't be cost-analyzed directly.
 PEAK_BF16_FLOPS = 197e12
 
 
-def _round_flops(exp, state, round_idx: int):
-    """XLA-counted FLOPs of one compiled round program (None if the
-    backend exposes no cost model)."""
+def _round_flops(exp, state):
+    """Analytic FLOPs of one round: XLA-counted FLOPs of a single
+    SCAN-FREE train step (value_and_grad on one batch) × local steps ×
+    cohort size. The whole-round program cannot be cost-analyzed
+    directly — XLA's cost model counts a ``lax.scan`` body ONCE, not
+    ×trip-count, under-reporting the 128-step round by ~128×. Optimizer
+    + psum + server-update FLOPs are elementwise (≪1% of fwd+bwd) and
+    ignored. Returns None if the backend exposes no cost model."""
     import jax
+    import jax.numpy as jnp
 
-    cohort, idx, mask, n_ex = exp._round_inputs(round_idx)
-    rng = jax.random.fold_in(state["rng_key"], round_idx)
+    from colearn_federated_learning_tpu.client.trainer import make_loss_fn
+
+    bs = exp.cfg.client.batch_size
+    x = jnp.asarray(exp.fed.train_x[:bs])
+    y = jnp.asarray(exp.fed.train_y[:bs])
+    m = jnp.ones((bs,), jnp.float32)
+    step = jax.value_and_grad(make_loss_fn(exp.model, exp.task))
     try:
-        compiled = exp.round_fn.lower(
-            state["params"], state["server_opt_state"],
-            exp.train_x, exp.train_y, idx, mask, n_ex, rng,
-        ).compile()
+        compiled = jax.jit(step).lower(state["params"], x, y, m).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
-        return float(ca["flops"]) if ca and "flops" in ca else None
+        if not ca or "flops" not in ca:
+            return None
+        return float(ca["flops"]) * exp.shape.steps * exp.cfg.server.cohort_size
     except Exception:
         return None
 
@@ -69,7 +80,7 @@ def main():
     exp = Experiment(cfg, echo=False)
     state = exp.init_state()
     state = exp._place_state(state)
-    flops_per_round = _round_flops(exp, state, 0)
+    flops_per_round = _round_flops(exp, state)
 
     # Rounds are dispatched asynchronously (the driver's production mode:
     # run.metrics_flush_every batches metric fetches); the timed region

@@ -80,7 +80,7 @@ def _select_tree(pred, new, old):
 
 
 def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str,
-                        batch_axis: str | None = None):
+                        batch_axis: str | None = None, local_dtype=None):
     """Build the pure local-training function for one client-round.
 
     ``batch_axis``: when the mesh carries a second axis that data-parallels
@@ -89,6 +89,15 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
     the psum of per-shard mask-weighted grad sums divided by the psummed
     mask count — exactly the full-batch masked mean, so results are
     bit-close to the unsharded path.
+
+    ``local_dtype``: cast the incoming global params to this dtype ONCE at
+    local-training entry (``run.local_param_dtype``). With f32 server
+    params and bf16 compute, XLA otherwise re-converts every parameter
+    f32→bf16 on every local step (~17% of round time on v5e — see the
+    BASELINE.md profile); casting once per client keeps the local phase
+    pure-bf16 while server-side aggregation and the cross-round parameter
+    trajectory stay f32. Returned params are in ``local_dtype``; the
+    aggregator's delta math upcasts back to f32.
     """
     opt = make_client_optimizer(client_cfg)
     grad_fn = jax.value_and_grad(make_loss_fn(model, task))
@@ -116,6 +125,10 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
 
     def local_train(global_params, train_x, train_y, idx, mask, rng):
         """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics)."""
+        if local_dtype is not None:
+            global_params = jax.tree.map(
+                lambda p: p.astype(local_dtype), global_params
+            )
 
         def step(carry, inp):
             params, opt_state = carry

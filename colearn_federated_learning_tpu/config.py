@@ -68,6 +68,12 @@ class ServerConfig:
     optimizer: str = "mean"
     server_lr: float = 1.0
     server_momentum: float = 0.9
+    # Cohort sampling: uniform over clients, or weighted with
+    # p ∝ client shard size (big-data clients drawn more often; pairs
+    # with uniform aggregation weights — the standard importance-sampling
+    # heuristic for example-weighted FedAvg, exact in the
+    # with-replacement limit).
+    sampling: str = "uniform"  # uniform | weighted
     # Simulated client dropout: fraction of the sampled cohort whose
     # update is zeroed inside the round function (straggler model).
     dropout_rate: float = 0.0
@@ -98,6 +104,17 @@ class RunConfig:
     # width × batch_size keeps the MXU fed for small models); 1 = pure
     # sequential scan (min memory), 0 = whole lane in one vmap
     client_vmap_width: int = 1
+    # Host-side round-input construction (idx/mask/n_ex tensors):
+    #   auto   — the C++ threaded pipeline (native/) when the toolchain
+    #            builds it, else the NumPy path; prefetches round r+1
+    #            while the device executes round r
+    #   native — require the C++ pipeline (error if unavailable)
+    #   numpy  — single-threaded NumPy construction (data/loader.py)
+    # Both are deterministic in (seed, round) but use different
+    # permutation RNGs; a resumed run only replays the original batch
+    # schedule on the same pipeline kind — pin "native" or "numpy"
+    # explicitly if a run may migrate across machines mid-flight.
+    host_pipeline: str = "auto"
     # rounds between metric fetches. Dispatch is async; only host fetches
     # pay the device round-trip (~100ms through this sandbox's relay), so
     # the driver buffers per-round metric scalars on device and drains
@@ -109,6 +126,13 @@ class RunConfig:
     sanitize: bool = False  # jax_debug_nans + finite-params assertions
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # bfloat16 on real TPU configs
+    # Mixed-precision local training: cast global params to this dtype
+    # ONCE per client at local-training entry ("" = keep param_dtype).
+    # With f32 params + bf16 compute, "bfloat16" removes the per-step
+    # f32→bf16 parameter conversions (~17% of round time on v5e, see
+    # BASELINE.md profile) while server aggregation and the cross-round
+    # trajectory stay f32.
+    local_param_dtype: str = ""
 
 
 @dataclass
@@ -133,6 +157,17 @@ class ExperimentConfig:
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
+        if self.server.sampling not in ("uniform", "weighted"):
+            raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
+        if self.run.host_pipeline not in ("auto", "native", "numpy"):
+            raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
+        for f in ("param_dtype", "compute_dtype"):
+            if getattr(self.run, f) not in ("float32", "bfloat16", "float16"):
+                raise ValueError(f"unknown run.{f} {getattr(self.run, f)!r}")
+        if self.run.local_param_dtype not in ("", "float32", "bfloat16", "float16"):
+            raise ValueError(
+                f"unknown run.local_param_dtype {self.run.local_param_dtype!r}"
+            )
         return self
 
     # ---- serialization ------------------------------------------------
@@ -232,7 +267,7 @@ def _cifar10_fedavg_100() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
         server=ServerConfig(num_rounds=500, cohort_size=16, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 
 
@@ -250,7 +285,7 @@ def _femnist_fedprox_500() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=32, lr=0.03, prox_mu=0.01),
         server=ServerConfig(num_rounds=500, cohort_size=16, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 
 
@@ -272,7 +307,7 @@ def _shakespeare_fedavg() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=16, lr=0.5),
         server=ServerConfig(num_rounds=200, cohort_size=8, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 
 
@@ -293,7 +328,7 @@ def _imagenet_silo_dp() -> ExperimentConfig:
         client=ClientConfig(local_epochs=1, batch_size=64, lr=0.003, optimizer="adamw"),
         server=ServerConfig(num_rounds=100, cohort_size=32, eval_every=5),
         dp=DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=0.8, microbatch_size=8),
-        run=RunConfig(compute_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 
 

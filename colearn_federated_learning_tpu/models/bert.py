@@ -98,12 +98,21 @@ def _build(num_classes: int = 0, vocab_size: int = 90, seq_len: int = 80,
     #   full      — T×T scores on one chip (fine at LEAF scale)
     #   blockwise — flash-style online-softmax scan of k/v blocks from
     #               HBM; O(T·block) memory, the single-chip long-context path
+    #   pallas    — the blockwise recurrence as a hand-tiled pallas TPU
+    #               kernel (ops/pallas_attention.py); interpret mode off-TPU
     #   ring      — sequence-parallel over the "seq" mesh axis; only valid
     #               inside parallel/sequence.py's shard_map wrapper
     if attention == "full":
         attn = causal_attention
     elif attention == "blockwise":
         attn = partial(blockwise_attention, block_size=block_size, causal=True)
+    elif attention == "pallas":
+        from colearn_federated_learning_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        attn = partial(flash_attention, causal=True,
+                       block_q=block_size, block_kv=block_size)
     elif attention == "ring":
         attn = partial(ring_attention, axis_name="seq", causal=True)
     else:

@@ -1,0 +1,162 @@
+"""Native (C++) host runtime: build-on-demand + ctypes bindings.
+
+The shared library is compiled from ``round_pipeline.cpp`` with g++ at
+first use and cached next to the source keyed by a content hash, so a
+source edit rebuilds and a cold checkout needs exactly one compile.
+Everything degrades gracefully: if no toolchain is present,
+:func:`available` returns False and callers fall back to the NumPy path
+(data/loader.py) — same schedule semantics, host-thread parallelism and
+prefetch lost.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "round_pipeline.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_BUILD_ERROR: Optional[str] = None
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(
+        tempfile.gettempdir(), f"colearn_round_pipeline_{digest}.so"
+    )
+
+
+def _build() -> str:
+    out = _lib_path()
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, out)  # atomic: concurrent builders race safely
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _BUILD_ERROR
+    with _LOCK:
+        if _LIB is not None or _BUILD_ERROR is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build())
+        except Exception as e:  # no g++, sandboxed tmp, ...
+            _BUILD_ERROR = f"{type(e).__name__}: {e}"
+            return None
+        lib.clp_create.restype = ctypes.c_void_p
+        lib.clp_create.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+        ]
+        lib.clp_destroy.argtypes = [ctypes.c_void_p]
+        lib.clp_submit.restype = ctypes.c_int
+        lib.clp_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        lib.clp_fetch.restype = ctypes.c_int
+        lib.clp_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _BUILD_ERROR
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeRoundPipeline:
+    """Threaded C++ builder of per-round (idx, mask, n_ex) tensors.
+
+    ``submit(round, cohort)`` enqueues construction on worker threads;
+    ``fetch(round, k)`` blocks until ready. The round driver submits
+    round r+1 while the device executes round r, so host-side index
+    construction overlaps device compute. Deterministic in
+    (seed, round, client) regardless of thread count.
+    """
+
+    def __init__(self, client_indices: Sequence[np.ndarray], local_epochs: int,
+                 steps_per_epoch: int, batch: int, cap: int, seed: int,
+                 n_threads: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native pipeline unavailable: {_BUILD_ERROR}")
+        self._lib = lib
+        offsets = np.zeros(len(client_indices) + 1, np.int64)
+        np.cumsum([len(ix) for ix in client_indices], out=offsets[1:])
+        ids = (np.concatenate(client_indices) if len(offsets) > 1 and offsets[-1]
+               else np.zeros(0, np.int64)).astype(np.int32)
+        self._steps = local_epochs * steps_per_epoch
+        self._batch = batch
+        if n_threads <= 0:
+            n_threads = min(8, max(2, (os.cpu_count() or 2) - 1))
+        # keep the arrays alive through the create call
+        self._h = lib.clp_create(
+            _ptr(offsets, ctypes.c_int64), _ptr(ids, ctypes.c_int32),
+            len(client_indices), local_epochs, steps_per_epoch, batch, cap,
+            ctypes.c_uint64(seed & (2**64 - 1)), n_threads,
+        )
+        if not self._h:
+            raise RuntimeError("clp_create failed")
+
+    def submit(self, round_idx: int, cohort: np.ndarray) -> None:
+        cohort = np.ascontiguousarray(cohort, np.int32)
+        rc = self._lib.clp_submit(
+            self._h, round_idx, _ptr(cohort, ctypes.c_int32), len(cohort)
+        )
+        if rc != 0:
+            raise RuntimeError(f"clp_submit rc={rc}")
+
+    def fetch(self, round_idx: int, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        idx = np.empty((k, self._steps, self._batch), np.int32)
+        mask = np.empty((k, self._steps, self._batch), np.float32)
+        n_ex = np.empty((k,), np.float32)
+        rc = self._lib.clp_fetch(
+            self._h, round_idx, k,
+            _ptr(idx, ctypes.c_int32), _ptr(mask, ctypes.c_float),
+            _ptr(n_ex, ctypes.c_float),
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"clp_fetch rc={rc} (round {round_idx} "
+                f"{'never submitted' if rc == -1 else 'cohort size mismatch'})"
+            )
+        return idx, mask, n_ex
+
+    def close(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.clp_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

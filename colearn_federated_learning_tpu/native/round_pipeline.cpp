@@ -1,0 +1,236 @@
+// Native host-side round-input pipeline (SURVEY.md §2 C8/C10 runtime side).
+//
+// The reference's runtime-around-the-compute is native (NCCL consumed
+// through torch.distributed — BASELINE.json:5); this is our TPU-side
+// equivalent for the *host* half of the data path: while the device
+// executes round r's XLA program, worker threads here build round r+1's
+// [K, steps, batch] int32 gather-index tensors, validity masks and
+// FedAvg weights — per-client subset selection, per-epoch Fisher-Yates
+// permutation, pad-and-pack — so index construction never sits on the
+// round loop's critical path at 1000-client scale.
+//
+// Determinism: every (client, round, epoch) stream is seeded purely by
+// (seed, round, cid, epoch) through splitmix64 — results are independent
+// of thread scheduling and machine, so multi-host processes computing
+// "identical copies" (parallel/distributed.py) stay bit-identical, and
+// checkpoint-resume replays the exact schedule.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this
+// environment); built on demand with g++ -O3 by _build() in
+// native/__init__.py (content-hash cached).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- deterministic RNG: splitmix64 + Lemire bounded draw -----------------
+
+struct SplitMix64 {
+  uint64_t state;
+  explicit SplitMix64(uint64_t seed) : state(seed) {}
+  uint64_t next() {
+    uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // unbiased [0, n) via Lemire's multiply-shift with rejection
+  uint64_t below(uint64_t n) {
+    uint64_t x = next();
+    __uint128_t m = (__uint128_t)x * n;
+    uint64_t l = (uint64_t)m;
+    if (l < n) {
+      uint64_t t = (0 - n) % n;
+      while (l < t) {
+        x = next();
+        m = (__uint128_t)x * n;
+        l = (uint64_t)m;
+      }
+    }
+    return (uint64_t)(m >> 64);
+  }
+};
+
+uint64_t mix(uint64_t a, uint64_t b) {
+  // one splitmix round over the combination — cheap keyed hashing
+  SplitMix64 s(a ^ (b + 0x9E3779B97F4A7C15ULL + (a << 6) + (a >> 2)));
+  return s.next();
+}
+
+// ---- the pipeline --------------------------------------------------------
+
+struct Job {
+  int64_t round;
+  std::vector<int32_t> cohort;
+};
+
+struct Slot {
+  std::vector<int32_t> idx;    // [k * steps * batch]
+  std::vector<float> mask;     // [k * steps * batch]
+  std::vector<float> n_ex;     // [k]
+  bool done = false;
+};
+
+struct Pipeline {
+  // federation layout (CSR): client c owns ids[offsets[c] .. offsets[c+1])
+  std::vector<int64_t> offsets;
+  std::vector<int32_t> ids;
+  int32_t local_epochs, steps_per_epoch, batch, cap;
+  uint64_t seed;
+
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  std::deque<Job> queue;
+  std::map<int64_t, Slot> slots;
+  std::vector<std::thread> workers;
+  bool stopping = false;
+
+  void fill_row(int64_t round, int32_t cid, int32_t* idx_row, float* mask_row,
+                float* n_out) const {
+    const int64_t begin = offsets[cid], end = offsets[cid + 1];
+    const int64_t size = end - begin;
+    const int64_t take = size > cap ? cap : size;
+    const int64_t per_epoch = (int64_t)steps_per_epoch * batch;
+
+    // subset selection (when the shard exceeds the cap): partial
+    // Fisher-Yates over a copy, keyed by (seed, round, cid)
+    std::vector<int32_t> chosen(ids.begin() + begin, ids.begin() + end);
+    if (size > take) {
+      SplitMix64 rng(mix(mix(seed, (uint64_t)round), (uint64_t)cid * 2 + 1));
+      for (int64_t i = 0; i < take; ++i) {
+        int64_t j = i + (int64_t)rng.below((uint64_t)(size - i));
+        std::swap(chosen[i], chosen[j]);
+      }
+      chosen.resize(take);
+    }
+
+    for (int32_t e = 0; e < local_epochs; ++e) {
+      // per-epoch shuffle keyed by (seed, round, cid, epoch)
+      SplitMix64 rng(
+          mix(mix(mix(seed, (uint64_t)round), (uint64_t)cid * 2), (uint64_t)e));
+      std::vector<int32_t> perm(chosen);
+      for (int64_t i = take - 1; i > 0; --i) {
+        int64_t j = (int64_t)rng.below((uint64_t)(i + 1));
+        std::swap(perm[i], perm[j]);
+      }
+      int32_t* out = idx_row + e * per_epoch;
+      float* mout = mask_row + e * per_epoch;
+      std::memcpy(out, perm.data(), take * sizeof(int32_t));
+      for (int64_t i = 0; i < take; ++i) mout[i] = 1.0f;
+      // padding stays 0 (index 0, mask 0) — masked no-ops on device
+    }
+    *n_out = (float)(take * local_epochs);
+  }
+
+  void build(const Job& job, Slot& slot) const {
+    const int64_t k = (int64_t)job.cohort.size();
+    const int64_t steps = (int64_t)local_epochs * steps_per_epoch;
+    const int64_t row_len = steps * batch;
+    slot.idx.assign(k * row_len, 0);
+    slot.mask.assign(k * row_len, 0.0f);
+    slot.n_ex.assign(k, 0.0f);
+    for (int64_t r = 0; r < k; ++r) {
+      fill_row(job.round, job.cohort[r], slot.idx.data() + r * row_len,
+               slot.mask.data() + r * row_len, slot.n_ex.data() + r);
+    }
+  }
+
+  void worker_loop() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stopping || !queue.empty(); });
+        if (stopping && queue.empty()) return;
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      Slot built;
+      build(job, built);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        Slot& s = slots[job.round];
+        s = std::move(built);
+        s.done = true;
+      }
+      cv_done.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* clp_create(const int64_t* offsets, const int32_t* ids, int64_t n_clients,
+                 int32_t local_epochs, int32_t steps_per_epoch, int32_t batch,
+                 int32_t cap, uint64_t seed, int32_t n_threads) {
+  auto* p = new Pipeline();
+  p->offsets.assign(offsets, offsets + n_clients + 1);
+  p->ids.assign(ids, ids + offsets[n_clients]);
+  p->local_epochs = local_epochs;
+  p->steps_per_epoch = steps_per_epoch;
+  p->batch = batch;
+  p->cap = cap;
+  p->seed = seed;
+  if (n_threads < 1) n_threads = 1;
+  for (int32_t i = 0; i < n_threads; ++i)
+    p->workers.emplace_back([p] { p->worker_loop(); });
+  return p;
+}
+
+void clp_destroy(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->cv_work.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+// Enqueue round construction (async). Duplicate submits are no-ops.
+int clp_submit(void* h, int64_t round, const int32_t* cohort, int32_t k) {
+  auto* p = static_cast<Pipeline*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    if (p->slots.count(round)) return 0;
+    p->slots.emplace(round, Slot{});  // reserve: marks "in flight"
+    Job j;
+    j.round = round;
+    j.cohort.assign(cohort, cohort + k);
+    p->queue.push_back(std::move(j));
+  }
+  p->cv_work.notify_one();
+  return 0;
+}
+
+// Blocking fetch; copies into caller buffers and frees the slot.
+// Returns 0 on success, -1 if the round was never submitted, -2 on a
+// cohort-size mismatch.
+int clp_fetch(void* h, int64_t round, int32_t k, int32_t* idx, float* mask,
+              float* n_ex) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  auto it = p->slots.find(round);
+  if (it == p->slots.end()) return -1;
+  p->cv_done.wait(lk, [&] { return it->second.done; });
+  Slot& s = it->second;
+  if ((int64_t)s.n_ex.size() != k) return -2;
+  std::memcpy(idx, s.idx.data(), s.idx.size() * sizeof(int32_t));
+  std::memcpy(mask, s.mask.data(), s.mask.size() * sizeof(float));
+  std::memcpy(n_ex, s.n_ex.data(), s.n_ex.size() * sizeof(float));
+  p->slots.erase(it);
+  return 0;
+}
+
+}  // extern "C"

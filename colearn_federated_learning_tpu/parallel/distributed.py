@@ -70,6 +70,13 @@ def maybe_initialize_from_env(env=None) -> bool:
     coordinator = env.get("COLEARN_COORDINATOR")
     if not coordinator:
         return False
+    missing = [k for k in ("COLEARN_NUM_PROCESSES", "COLEARN_PROCESS_ID")
+               if k not in env]
+    if missing:
+        raise RuntimeError(
+            f"COLEARN_COORDINATOR is set but {', '.join(missing)} "
+            f"is missing; explicit bring-up needs all three variables"
+        )
     initialize(
         coordinator,
         env["COLEARN_NUM_PROCESSES"],

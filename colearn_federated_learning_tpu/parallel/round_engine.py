@@ -52,7 +52,8 @@ class RoundMetrics(NamedTuple):
 
 def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           cohort_size: int, donate: bool = True,
-                          client_vmap_width: int = 1):
+                          client_vmap_width: int = 1, local_dtype=None,
+                          agg: str = "examples"):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -61,9 +62,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
          idx [K,steps,batch], mask [K,steps,batch], n_ex [K], rng)
         → (new_params, new_server_opt_state, RoundMetrics)
 
-    ``n_ex`` are the FedAvg weights; simulated client dropout
+    ``n_ex`` are the per-client example counts; simulated client dropout
     (SURVEY.md §5) is upstream zeroing of entries — exact math, no
     control-flow divergence.
+
+    ``agg`` selects the FedAvg weights: ``"examples"`` (wᵢ = nᵢ, the
+    classic example-weighted mean, correct under UNIFORM cohort
+    sampling) or ``"uniform"`` (wᵢ = 1 for participants — the unbiased
+    pairing for size-proportional ``server.sampling="weighted"``, where
+    example-weighting would count shard size twice). Dropped clients
+    (nᵢ = 0) carry zero weight in both modes; the ``examples`` metric
+    always reports Σnᵢ.
 
     ``client_vmap_width``: how many of a lane's clients train as one
     ``vmap`` block (effective conv/matmul batch = width × batch_size —
@@ -83,6 +92,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task,
         batch_axis=BATCH_AXIS if batch_sharded else None,
+        local_dtype=local_dtype,
     )
     n_lanes = mesh.shape[CLIENT_AXIS]
     if cohort_size % n_lanes != 0:
@@ -96,6 +106,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             f"use 0 for the full lane"
         )
 
+    if agg not in ("examples", "uniform"):
+        raise ValueError(f"unknown aggregation mode {agg!r}")
+
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
         # Mark params as device-varying so scan carries (which mix in
@@ -107,15 +120,24 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             w_b, m_b = jax.vmap(
                 local_train, in_axes=(None, None, None, 0, 0, 0)
             )(params, train_x, train_y, b_idx, b_mask, b_keys)
-            d_acc, n_acc, l_acc = acc
-            # Σ over the block of n_i·(w_i − w₀), fused as one contraction
+            # FedAvg weight per client: example count, or participation
+            # (n>0) under "uniform" — dropout zeroing propagates either way
+            b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
+            d_acc, w_acc, n_acc, l_acc = acc
+            # Σ over the block of w_i·(Δ_i), fused as one contraction;
+            # delta math in the ACCUMULATOR dtype (f32 server params):
+            # bf16 local weights upcast here, so client-side mixed
+            # precision never degrades the aggregation
             d_acc = jax.tree.map(
                 lambda a, w, p: a + jnp.einsum(
-                    "c,c...->...", b_n.astype(w.dtype), w - p[None]
+                    "c,c...->...",
+                    b_w.astype(a.dtype),
+                    (w.astype(a.dtype) - p[None].astype(a.dtype)),
                 ).astype(a.dtype),
                 d_acc, w_b, params,
             )
-            return (d_acc, n_acc + b_n.sum(), l_acc + (b_n * m_b.loss).sum()), None
+            return (d_acc, w_acc + b_w.sum(), n_acc + b_n.sum(),
+                    l_acc + (b_w * m_b.loss).sum()), None
 
         n_blocks = idx.shape[0] // width
         blocked = jax.tree.map(
@@ -123,15 +145,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             (idx, mask, n_ex, keys),
         )
         acc0 = _pcast_varying(
-            (trees.tree_zeros_like(params), jnp.zeros(()), jnp.zeros(()))
+            (trees.tree_zeros_like(params),
+             jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
         )
-        (d_sum, n_sum, l_sum), _ = jax.lax.scan(per_block, acc0, blocked)
+        (d_sum, w_sum, n_sum, l_sum), _ = jax.lax.scan(per_block, acc0, blocked)
         # The aggregation collective — the reference's NCCL allreduce
         # (BASELINE.json:5) as a single XLA psum over the ICI.
         d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
+        w_sum = jax.lax.psum(w_sum, CLIENT_AXIS)
         n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
         l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
-        denom = jnp.maximum(n_sum, 1.0)
+        denom = jnp.maximum(w_sum, 1.0)
         mean_delta = trees.tree_scale(d_sum, 1.0 / denom)
         return mean_delta, n_sum, l_sum / denom
 
@@ -159,12 +183,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     return round_fn
 
 
-def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update):
+def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
+                             local_dtype=None, agg: str = "examples"):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
     engine is tested against (SURVEY.md §4.3)."""
-    local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task))
+    if agg not in ("examples", "uniform"):
+        raise ValueError(f"unknown aggregation mode {agg!r}")
+    local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
+                                              local_dtype=local_dtype))
     update = jax.jit(server_update)
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
@@ -174,10 +202,11 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update):
         for c in range(k):
             w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c], keys[c])
             deltas.append(trees.tree_sub(w_i, params))
-            weights.append(n_ex[c])
+            n_c = jnp.asarray(n_ex[c])
+            weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
             losses.append(m_i.loss)
-        n_total = jnp.sum(jnp.stack([jnp.asarray(w) for w in weights]))
-        denom = jnp.maximum(n_total, 1.0)
+        n_total = jnp.asarray(n_ex).sum()
+        denom = jnp.maximum(jnp.sum(jnp.stack(weights)), 1.0)
         acc = trees.tree_zeros_like(params)
         for d, w in zip(deltas, weights):
             acc = trees.tree_axpy(w, d, acc)

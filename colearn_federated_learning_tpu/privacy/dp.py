@@ -68,6 +68,12 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
             losses, grads = jax.vmap(single_example_grad, in_axes=(None, 0, 0))(
                 params, xs, ys
             )  # grads: pytree with leading [mb]
+            # The privacy-critical math runs in f32 no matter what dtype
+            # training uses (run.local_param_dtype may be bf16): the clip
+            # norm is an f32 sum of squares of the exact released values,
+            # so ‖scale·g‖₂ ≤ l2_clip holds in f32 and the accountant's
+            # sensitivity assumption stays valid.
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             norms = jnp.sqrt(
                 sum(
                     jnp.sum(jnp.square(g.reshape(mb, -1)), axis=1)
@@ -83,9 +89,13 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
 
         # Initial accumulators derive their sharding type from the data
         # (0·Σm), so the scan carry type-checks identically inside a
-        # shard_map lane (device-varying) and in plain jit.
+        # shard_map lane (device-varying) and in plain jit. Accumulation
+        # is f32 even under bf16 training (see micro_step).
         zero_scalar = 0.0 * m.sum()
-        zero = jax.tree.map(lambda p: jnp.zeros_like(p) + zero_scalar.astype(p.dtype), params)
+        zero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32) + zero_scalar.astype(jnp.float32),
+            params,
+        )
         (g_sum, loss_sum), _ = jax.lax.scan(
             micro_step, (zero, zero_scalar), (xm, ym, mm)
         )
@@ -98,10 +108,16 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
         keys = jax.random.split(rng, len(jax.tree.leaves(params)))
         keys = jax.tree.unflatten(jax.tree.structure(params), list(keys))
         sigma = cfg.noise_multiplier * cfg.l2_clip
+        # Noise is drawn and added in f32 (an exact Gaussian at σ, as the
+        # accountant assumes); the cast back to the training dtype is
+        # post-processing, which preserves the DP guarantee.
         noisy = jax.tree.map(
-            lambda g, k: (g + sigma * jax.random.normal(k, g.shape, g.dtype)) / denom,
+            lambda g, k, p: (
+                (g + sigma * jax.random.normal(k, g.shape, jnp.float32)) / denom
+            ).astype(p.dtype),
             g_sum,
             keys,
+            params,
         )
         return loss_sum / denom, noisy
 

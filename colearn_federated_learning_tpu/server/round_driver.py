@@ -58,9 +58,20 @@ class Experiment:
         self.task = self.fed.task
         self.shape = compute_round_shape(self.fed, cfg.client, cfg.data)
         self.sampler = CohortSampler(
-            self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed
+            self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
+            weights=(
+                self.fed.client_sizes() if cfg.server.sampling == "weighted" else None
+            ),
         )
         self.server_opt_init, server_update = make_server_update_fn(cfg.server)
+        # Size-proportional sampling pairs with UNIFORM aggregation
+        # weights: example-weighting on top of p∝size sampling would count
+        # shard size twice (contribution ∝ size²). Uniform sampling keeps
+        # classic example-weighted FedAvg. (The pairing is the standard FL
+        # importance-sampling heuristic — exactly unbiased only in the
+        # with-replacement limit; without-replacement cohorts cap a huge
+        # client's inclusion probability at 1, mildly under-weighting it.)
+        agg = "uniform" if cfg.server.sampling == "weighted" else "examples"
 
         if cfg.run.engine == "sharded":
             batch_shards = max(1, cfg.run.batch_shards)
@@ -89,6 +100,7 @@ class Experiment:
                 self.model, cfg.client, cfg.dp, self.task, self.mesh,
                 server_update, cfg.server.cohort_size,
                 client_vmap_width=cfg.run.client_vmap_width,
+                local_dtype=self._local_dtype(), agg=agg,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -97,7 +109,8 @@ class Experiment:
         else:
             self.mesh = None
             self.round_fn = make_sequential_round_fn(
-                self.model, cfg.client, cfg.dp, self.task, server_update
+                self.model, cfg.client, cfg.dp, self.task, server_update,
+                local_dtype=self._local_dtype(), agg=agg,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -119,7 +132,31 @@ class Experiment:
         self.logger = MetricsLogger(cfg.run.out_dir or None, cfg.name, echo=echo,
                                     append=cfg.run.resume)
 
+        # Host-side round-input construction: the C++ threaded pipeline
+        # (native/round_pipeline.cpp) builds + prefetches index tensors off
+        # the round loop's critical path; NumPy path otherwise.
+        self._native = None
+        if cfg.run.host_pipeline in ("auto", "native"):
+            from colearn_federated_learning_tpu import native
+
+            if native.available():
+                self._native = native.NativeRoundPipeline(
+                    self.fed.client_indices,
+                    self.shape.local_epochs, self.shape.steps_per_epoch,
+                    self.shape.batch_size, self.shape.cap,
+                    seed=cfg.run.seed,
+                )
+            elif cfg.run.host_pipeline == "native":
+                raise RuntimeError(
+                    f"run.host_pipeline=native but the C++ pipeline cannot "
+                    f"be built: {native.build_error()}"
+                )
+
     # ------------------------------------------------------------------
+
+    def _local_dtype(self):
+        d = self.cfg.run.local_param_dtype
+        return _DTYPES[d] if d else None
 
     def _put(self, arr, sharding):
         if sharding is None:
@@ -159,7 +196,15 @@ class Experiment:
     def _round_inputs(self, round_idx: int):
         cohort = self.sampler.sample(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
-        idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+        if self._native is not None:
+            self._native.submit(round_idx, cohort)  # no-op if prefetched
+            if round_idx + 1 < self.cfg.server.num_rounds:
+                # overlap: round r+1's tensors build on C++ worker threads
+                # while the device executes round r
+                self._native.submit(round_idx + 1, self.sampler.sample(round_idx + 1))
+            idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
+        else:
+            idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
         if self.cfg.server.dropout_rate > 0:
             # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
             participate = (
@@ -204,7 +249,13 @@ class Experiment:
             if cfg.run.resume and store and store.latest_step() is not None:
                 template = self.init_state()
                 state, step = store.restore(template=template)
-                self.logger.log({"event": "resumed", "round": int(state["round"])})
+                self.logger.log({
+                    "event": "resumed", "round": int(state["round"]),
+                    # the two host pipelines use different (both
+                    # deterministic) permutation RNGs; exact schedule
+                    # replay requires resuming on the same kind
+                    "host_pipeline": "native" if self._native else "numpy",
+                })
             else:
                 state = self.init_state()
         state = self._place_state(state)

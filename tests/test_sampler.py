@@ -1,0 +1,166 @@
+"""Cohort sampler (SURVEY.md §2 C4): stateless (seed, round)-pure
+sampling, uniform and size-weighted modes, and the config wiring."""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.sampler import CohortSampler
+
+
+def test_deterministic_and_without_replacement():
+    s = CohortSampler(num_clients=50, cohort_size=10, seed=3)
+    a, b = s.sample(7), s.sample(7)
+    np.testing.assert_array_equal(a, b)
+    assert len(np.unique(a)) == 10
+    assert (s.sample(8) != a).any()
+
+
+def test_weighted_sampling_prefers_big_shards():
+    sizes = np.array([1.0] * 40 + [100.0] * 10)
+    s = CohortSampler(num_clients=50, cohort_size=5, seed=0, weights=sizes)
+    hits = np.zeros(50)
+    for r in range(400):
+        hits[s.sample(r)] += 1
+    # the 10 heavy clients (100× weight) must dominate the draws
+    assert hits[40:].sum() > 3 * hits[:40].sum(), hits
+
+
+def test_cohort_too_big_rejected():
+    with pytest.raises(ValueError):
+        CohortSampler(num_clients=4, cohort_size=5, seed=0)
+
+
+def test_config_wires_weighted_sampling():
+    cfg = get_named_config("cifar10_fedavg_100")
+    cfg.server.sampling = "weighted"
+    cfg.data.num_clients = 8
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 32
+    cfg.server.cohort_size = 4
+    cfg.run.out_dir = ""
+    cfg.model.kwargs["width"] = 8
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    sizes = exp.fed.client_sizes().astype(np.float64)
+    np.testing.assert_allclose(exp.sampler.probs, sizes / sizes.sum())
+
+    cfg.server.sampling = "nope"
+    with pytest.raises(ValueError, match="sampling"):
+        cfg.validate()
+
+
+def test_weighted_sampling_uses_uniform_aggregation():
+    """p∝size sampling must NOT also example-weight the mean (size would
+    count twice): under agg="uniform" every participant's delta carries
+    weight 1 regardless of n_ex, and dropped clients (n=0) still carry 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.config import ClientConfig, DPConfig
+    from colearn_federated_learning_tpu.models import build_model, init_params
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+    )
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+    from colearn_federated_learning_tpu.config import ServerConfig
+
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    train_x = jnp.asarray(rng.uniform(0, 1, (32, 28, 28, 1)).astype(np.float32))
+    train_y = jnp.asarray(rng.integers(0, 10, 32).astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, 32, (3, 2, 4)).astype(np.int32))
+    mask = jnp.ones((3, 2, 4), jnp.float32)
+    ccfg = ClientConfig(batch_size=4, lr=0.1, momentum=0.0)
+    sinit, supdate = make_server_update_fn(ServerConfig(optimizer="mean"))
+    key = jax.random.PRNGKey(7)
+
+    def run(agg, n_ex):
+        fn = make_sequential_round_fn(model, ccfg, DPConfig(), "classify",
+                                      supdate, agg=agg)
+        p, _, m = fn(params, sinit(params), train_x, train_y, idx, mask,
+                     jnp.asarray(n_ex, jnp.float32), key)
+        return p, m
+
+    # wildly skewed example counts: uniform agg must be invariant to them
+    p_skew, m_skew = run("uniform", [100.0, 1.0, 1.0])
+    p_flat, m_flat = run("uniform", [8.0, 8.0, 8.0])
+    for a, b in zip(jax.tree.leaves(p_skew), jax.tree.leaves(p_flat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # ...while example-weighted agg is not
+    p_ex, _ = run("examples", [100.0, 1.0, 1.0])
+    diff = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree.leaves(p_ex), jax.tree.leaves(p_flat))
+    )
+    assert diff > 1e-6
+    # examples metric still reports Σn, not the weight sum
+    assert float(m_skew.examples) == 102.0
+    # dropped client (n=0) contributes nothing even under uniform agg
+    p_drop, _ = run("uniform", [8.0, 8.0, 0.0])
+    changed = any(
+        (np.asarray(a) != np.asarray(b)).any()
+        for a, b in zip(jax.tree.leaves(p_drop), jax.tree.leaves(p_flat))
+    )
+    assert changed
+
+
+def test_sharded_uniform_agg_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.config import (
+        ClientConfig, DPConfig, ServerConfig,
+    )
+    from colearn_federated_learning_tpu.models import build_model, init_params
+    from colearn_federated_learning_tpu.parallel.mesh import (
+        build_client_mesh, client_sharded, cohort_sharded, replicated,
+    )
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn, make_sharded_round_fn,
+    )
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(1)
+    train_x = jnp.asarray(rng.uniform(0, 1, (64, 28, 28, 1)).astype(np.float32))
+    train_y = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+    k = 8
+    idx = rng.integers(0, 64, (k, 2, 4)).astype(np.int32)
+    mask = np.ones((k, 2, 4), np.float32)
+    n_ex = np.asarray([8, 8, 8, 8, 1, 2, 0, 8], np.float32)
+    ccfg = ClientConfig(batch_size=4, lr=0.1, momentum=0.9)
+    sinit, supdate = make_server_update_fn(ServerConfig(optimizer="mean"))
+    key = jax.random.PRNGKey(3)
+
+    seq = make_sequential_round_fn(model, ccfg, DPConfig(), "classify",
+                                   supdate, agg="uniform")
+    p_seq, _, m_seq = seq(params, sinit(params), train_x, train_y,
+                          jnp.asarray(idx), jnp.asarray(mask),
+                          jnp.asarray(n_ex), key)
+
+    mesh = build_client_mesh(8)
+    shd = make_sharded_round_fn(model, ccfg, DPConfig(), "classify", mesh,
+                                supdate, cohort_size=k, donate=False,
+                                agg="uniform")
+    p_shd, _, m_shd = shd(
+        jax.device_put(params, replicated(mesh)),
+        jax.device_put(sinit(params), replicated(mesh)),
+        jax.device_put(train_x, replicated(mesh)),
+        jax.device_put(train_y, replicated(mesh)),
+        jax.device_put(jnp.asarray(idx), cohort_sharded(mesh)),
+        jax.device_put(jnp.asarray(mask), cohort_sharded(mesh)),
+        jax.device_put(jnp.asarray(n_ex), client_sharded(mesh)),
+        key,
+    )
+    for a, b in zip(jax.tree.leaves(p_seq), jax.tree.leaves(p_shd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=2e-6)
+    np.testing.assert_allclose(float(m_seq.examples), float(m_shd.examples))
