@@ -43,6 +43,15 @@ class DataConfig:
     # Cap on examples a client contributes per round (static-shape pad target;
     # 0 = derive from the largest client shard).
     max_examples_per_client: int = 0
+    # Where the training corpus lives during the round loop:
+    #   hbm    — whole corpus uploaded once, rounds gather on device
+    #            (fastest; requires the corpus to fit in device memory)
+    #   stream — corpus stays in host RAM; each round only the cohort's
+    #            examples are gathered into a slab and uploaded, with the
+    #            index tensors remapped into it. Unlocks corpora larger
+    #            than HBM (e.g. real ImageNet at 224px) at the cost of a
+    #            per-round host→device transfer.
+    placement: str = "hbm"  # hbm | stream
 
 
 @dataclass
@@ -161,6 +170,8 @@ class ExperimentConfig:
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
+        if self.data.placement not in ("hbm", "stream"):
+            raise ValueError(f"unknown data.placement {self.data.placement!r}")
         for f in ("param_dtype", "compute_dtype"):
             if getattr(self.run, f) not in ("float32", "bfloat16", "float16"):
                 raise ValueError(f"unknown run.{f} {getattr(self.run, f)!r}")

@@ -22,10 +22,7 @@ from typing import Callable
 
 from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
 from colearn_federated_learning_tpu.ops.attention import causal_attention
-from colearn_federated_learning_tpu.ops.ring_attention import (
-    blockwise_attention,
-    ring_attention,
-)
+from colearn_federated_learning_tpu.ops.backends import resolve_attention
 
 
 class TransformerBlock(nn.Module):
@@ -91,33 +88,16 @@ class BertTinyLM(nn.Module):
 
 @model_registry.register("bert_tiny")
 def _build(num_classes: int = 0, vocab_size: int = 90, seq_len: int = 80,
+           hidden: int = 128, heads: int = 2, layers: int = 2, ff: int = 512,
            attention: str = "full", block_size: int = 128,
            compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
     del num_classes  # LM: output dim == vocab_size
-    # attention backends (all exact, all causal):
-    #   full      — T×T scores on one chip (fine at LEAF scale)
-    #   blockwise — flash-style online-softmax scan of k/v blocks from
-    #               HBM; O(T·block) memory, the single-chip long-context path
-    #   pallas    — the blockwise recurrence as a hand-tiled pallas TPU
-    #               kernel (ops/pallas_attention.py); interpret mode off-TPU
-    #   ring      — sequence-parallel over the "seq" mesh axis; only valid
-    #               inside parallel/sequence.py's shard_map wrapper
-    if attention == "full":
-        attn = causal_attention
-    elif attention == "blockwise":
-        attn = partial(blockwise_attention, block_size=block_size, causal=True)
-    elif attention == "pallas":
-        from colearn_federated_learning_tpu.ops.pallas_attention import (
-            flash_attention,
-        )
-
-        attn = partial(flash_attention, causal=True,
-                       block_q=block_size, block_kv=block_size)
-    elif attention == "ring":
-        attn = partial(ring_attention, axis_name="seq", causal=True)
-    else:
-        raise ValueError(f"unknown attention backend {attention!r}")
-    return BertTinyLM(vocab_size=vocab_size, seq_len=seq_len, attention_fn=attn,
+    # causal attention backend: full | blockwise | pallas | ring —
+    # see ops/backends.py for what each one is
+    attn = resolve_attention(attention, causal=True, block_size=block_size)
+    return BertTinyLM(vocab_size=vocab_size, seq_len=seq_len,
+                      hidden=hidden, heads=heads, layers=layers, ff=ff,
+                      attention_fn=attn,
                       compute_dtype=compute_dtype, param_dtype=param_dtype)
 
 

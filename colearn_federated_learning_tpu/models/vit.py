@@ -9,12 +9,14 @@ cross-silo FedAvg/DP path aggregates everything uniformly.
 from __future__ import annotations
 
 from functools import partial
+from typing import Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
 from colearn_federated_learning_tpu.ops.attention import full_attention
+from colearn_federated_learning_tpu.ops.backends import resolve_attention
 
 
 class ViTBlock(nn.Module):
@@ -23,6 +25,7 @@ class ViTBlock(nn.Module):
     mlp_dim: int
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable = full_attention  # (q, k, v, heads) → out
 
     @nn.compact
     def __call__(self, x):
@@ -31,7 +34,7 @@ class ViTBlock(nn.Module):
         h = ln()(x)
         qkv = dense(3 * self.hidden)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        att = full_attention(q, k, v, self.heads)
+        att = self.attention_fn(q, k, v, self.heads)
         x = x + dense(self.hidden)(att)
         h = ln()(x)
         h = nn.gelu(dense(self.mlp_dim)(h))
@@ -49,6 +52,7 @@ class ViT(nn.Module):
     mlp_dim: int = 3072
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable = full_attention
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -72,7 +76,8 @@ class ViT(nn.Module):
         x = x + pos.astype(x.dtype)
         for _ in range(self.layers):
             x = ViTBlock(self.hidden, self.heads, self.mlp_dim,
-                         self.compute_dtype, self.param_dtype)(x)
+                         self.compute_dtype, self.param_dtype,
+                         self.attention_fn)(x)
         x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=self.param_dtype)(x[:, 0])
@@ -81,11 +86,19 @@ class ViT(nn.Module):
 @model_registry.register("vit_b16")
 def _build(num_classes: int = 1000, image_size: int = 224, patch_size: int = 16,
            hidden: int = 768, layers: int = 12, heads: int = 12, mlp_dim: int = 3072,
+           attention: str = "full", block_size: int = 128,
            compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
     # geometry kwargs are overridable so tests/small studies can shrink the
-    # model while exercising the identical DP+silo code path
+    # model while exercising the identical DP+silo code path.
+    # attention="pallas" uses the fused kernel; the 197-token sequence is
+    # padded to a block multiple with masked keys inside the kernel.
+    # (blockwise/ring are causal-oriented and need divisible T — not
+    # offered here.)
+    attn = resolve_attention(attention, causal=False, block_size=block_size,
+                             supported=("full", "pallas"))
     return ViT(num_classes=num_classes, image_size=image_size, patch_size=patch_size,
                hidden=hidden, layers=layers, heads=heads, mlp_dim=mlp_dim,
+               attention_fn=attn,
                compute_dtype=compute_dtype, param_dtype=param_dtype)
 
 

@@ -15,7 +15,6 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import tempfile
 import threading
 from typing import Optional, Sequence, Tuple
 
@@ -30,9 +29,17 @@ _BUILD_ERROR: Optional[str] = None
 def _lib_path() -> str:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(
-        tempfile.gettempdir(), f"colearn_round_pipeline_{digest}.so"
+    # user-owned 0700 cache dir — never a world-writable location like
+    # /tmp, where a predictable .so path could be pre-planted by another
+    # local user and loaded into this process
+    # XDG spec: empty XDG_CACHE_HOME means unset — `or` keeps the
+    # fallback from degrading to a cwd-relative (possibly shared) dir
+    cache = os.path.join(
+        os.path.expanduser(os.environ.get("XDG_CACHE_HOME") or "~/.cache"),
+        "colearn_tpu",
     )
+    os.makedirs(cache, mode=0o700, exist_ok=True)
+    return os.path.join(cache, f"round_pipeline_{digest}.so")
 
 
 def _build() -> str:

@@ -1,0 +1,53 @@
+"""Attention-backend registry shared by the transformer models.
+
+One name→callable mapping so BERT-tiny and ViT dispatch identically and
+a new backend (or kwarg) lands in exactly one place. All backends are
+exact; they differ in memory/communication shape:
+
+- ``full``      — T×T scores on one chip (XLA-fused; fastest at short T)
+- ``blockwise`` — flash-style online-softmax scan of k/v blocks from HBM;
+                  O(T·block) memory (single-chip long-context)
+- ``pallas``    — the blockwise recurrence as a hand-tiled pallas TPU
+                  kernel (ops/pallas_attention.py); interpret mode off-TPU
+- ``ring``      — sequence-parallel over the "seq" mesh axis via ppermute
+                  (ops/ring_attention.py); only valid inside
+                  parallel/sequence.py's shard_map wrapper
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from colearn_federated_learning_tpu.ops.attention import (
+    causal_attention,
+    full_attention,
+)
+
+_ALL = ("full", "blockwise", "pallas", "ring")
+
+
+def resolve_attention(name: str, *, causal: bool, block_size: int = 128,
+                      supported=_ALL):
+    """(q, k, v, heads) → out callable for a backend name."""
+    if name not in supported:
+        raise ValueError(
+            f"unknown attention backend {name!r}; supported: {list(supported)}"
+        )
+    if name == "full":
+        return causal_attention if causal else full_attention
+    if name == "blockwise":
+        from colearn_federated_learning_tpu.ops.ring_attention import (
+            blockwise_attention,
+        )
+
+        return partial(blockwise_attention, block_size=block_size, causal=causal)
+    if name == "pallas":
+        from colearn_federated_learning_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        return partial(flash_attention, causal=causal,
+                       block_q=block_size, block_kv=block_size)
+    from colearn_federated_learning_tpu.ops.ring_attention import ring_attention
+
+    return partial(ring_attention, axis_name="seq", causal=causal)

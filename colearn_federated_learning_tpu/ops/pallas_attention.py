@@ -40,10 +40,15 @@ _NEG_BIG = -1e30
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
-                 n_kv: int, causal: bool, scale: float):
+                 n_kv: int, kv_len: int, causal: bool, scale: float):
+    # kv_len: number of REAL keys (< padded length when the sequence was
+    # padded up to a block multiple); keys past it are masked out. Real
+    # causal queries never see padded keys (q_pos < kv_len ⇒ k_pos ≤
+    # q_pos < kv_len), and padded query rows are sliced off outside.
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, hd]
     hd = q.shape[-1]
+    padded = kv_len < n_kv * block_kv
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_kv), 0
     )
@@ -57,16 +62,19 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_kv: int,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_kv]
-        if causal:
+        keep = None
+        if causal or padded:
             k_pos = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
-            keep = q_pos >= k_pos
+            keep = q_pos >= k_pos if causal else k_pos < kv_len
+            if causal and padded:
+                keep = keep & (k_pos < kv_len)
             s = jnp.where(keep, s, _NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
-        if causal:
+        if keep is not None:
             p = jnp.where(keep, p, 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
@@ -97,32 +105,48 @@ def _flash_fwd_impl(q, k, v, heads: int, causal: bool, block_q: int,
     b, h, t, hd = qh.shape
     bq = min(block_q, t)
     bkv = min(block_kv, t)
+    # Non-divisible sequence lengths (e.g. ViT's 197 tokens) are padded up
+    # to a block multiple; padded keys are masked inside the kernel via
+    # kv_len and padded query rows are sliced off below. When padding is
+    # needed both block sizes collapse to the smaller one so the pad is
+    # bounded by one block — lcm of clamped ragged blocks (e.g. 50 and 32)
+    # could otherwise blow the sequence up many-fold.
     if t % bq or t % bkv:
-        raise ValueError(
-            f"seq len {t} must be divisible by block_q={bq}, block_kv={bkv}"
-        )
-    qh = qh.reshape(b * h, t, hd)
-    kh = kh.reshape(b * h, t, hd)
-    vh = vh.reshape(b * h, t, hd)
+        bq = bkv = min(bq, bkv)
+    tp = ((t + bkv - 1) // bkv) * bkv
+    if tp != t:
+        pad = [(0, 0), (0, 0), (0, tp - t), (0, 0)]
+        qh, kh, vh = (jnp.pad(x, pad) for x in (qh, kh, vh))
+    qh = qh.reshape(b * h, tp, hd)
+    kh = kh.reshape(b * h, tp, hd)
+    vh = vh.reshape(b * h, tp, hd)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(
-        _attn_kernel, block_q=bq, block_kv=bkv, n_kv=t // bkv,
+        _attn_kernel, block_q=bq, block_kv=bkv, n_kv=tp // bkv, kv_len=t,
         causal=causal, scale=hd**-0.5,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, t // bq),
+        grid=(b * h, tp // bq),
         in_specs=[
             pl.BlockSpec((1, bq, hd), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, hd), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, hd), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tp, hd), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tp, hd), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, hd), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, hd), q.dtype),
+        # Inside shard_map (the round engine's clients mesh) inputs are
+        # device-varying; the kernel output varies the same way, and
+        # shard_map's vma checker requires that stated explicitly.
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, tp, hd), q.dtype,
+            vma=frozenset().union(*(
+                getattr(jax.typeof(x), "vma", frozenset()) for x in (qh, kh, vh)
+            )),
+        ),
         interpret=interpret,
     )(qh, kh, vh)
-    return _merge_heads(out.reshape(b, h, t, hd))
+    return _merge_heads(out.reshape(b, h, tp, hd)[:, :, :t])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -139,11 +163,39 @@ def _flash_fwd(q, k, v, heads, causal, block_q, block_kv, interpret):
 
 def _flash_bwd(heads, causal, block_q, block_kv, interpret, residuals, g):
     q, k, v = residuals
-    block = min(block_kv, q.shape[1])
+    t = q.shape[1]
+    block = min(block_q, block_kv, t)
+    if t % block == 0:
+        # long-context path: O(T·block) memory recompute
+        def ref(q_, k_, v_):
+            return blockwise_attention(q_, k_, v_, heads, block_size=block,
+                                       causal=causal)
+
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp(g)
+    if causal:
+        # Non-divisible causal lengths keep the O(T·block) recompute by
+        # zero-padding to a block multiple: padded keys sit at positions
+        # ≥ t so no real query attends them, and the padded query rows'
+        # cotangents are zero, so sliced gradients are exact.
+        tp = ((t + block - 1) // block) * block
+        pad = [(0, 0), (0, tp - t), (0, 0)]
+        qp, kp, vp = (jnp.pad(x, pad) for x in (q, k, v))
+        gp = jnp.pad(g, pad)
+
+        def ref(q_, k_, v_):
+            return blockwise_attention(q_, k_, v_, heads, block_size=block,
+                                       causal=True)
+
+        _, vjp = jax.vjp(ref, qp, kp, vp)
+        return tuple(x[:, :t] for x in vjp(gp))
+    # Non-causal non-divisible (ViT's 197 tokens): zero-padded keys WOULD
+    # attract real attention weight, so recompute with plain attention —
+    # T×T scores are fine at the scales where such lengths occur.
+    from colearn_federated_learning_tpu.ops.attention import full_attention
 
     def ref(q_, k_, v_):
-        return blockwise_attention(q_, k_, v_, heads, block_size=block,
-                                   causal=causal)
+        return full_attention(q_, k_, v_, heads)
 
     _, vjp = jax.vjp(ref, q, k, v)
     return vjp(g)

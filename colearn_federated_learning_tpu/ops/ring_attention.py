@@ -55,7 +55,13 @@ def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
     vh = _split_heads(v, heads)
     b, h, t_loc, hd = qh.shape
     scale = hd**-0.5
-    qh = qh * scale
+    # All recurrence math in f32: bf16 einsums inside the scan backward
+    # miscompile to NaN on this TPU backend (values are fine in isolation
+    # but not when fused into a larger differentiated graph — see
+    # tests/test_pallas_attention.py::test_bf16_lm_gradients_finite).
+    # k/v stay in their wire dtype for the ppermute (half the ICI bytes)
+    # and are upcast per-use.
+    qh = qh.astype(jnp.float32) * scale
     q_pos = me * t_loc + jnp.arange(t_loc)  # global positions of our queries
 
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -64,7 +70,7 @@ def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
         o, m, l, kh_cur, vh_cur = carry
         # the block we currently hold originated at lane (me - step) mod n
         src = jax.lax.rem(me - step + n, n)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh_cur).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh_cur.astype(jnp.float32))
         if causal:
             k_pos = src * t_loc + jnp.arange(t_loc)
             keep = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
@@ -77,8 +83,8 @@ def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
             p = jnp.where(keep[None, None], p, 0.0)  # kill exp(0) on dead rows
         l_new = l * corr + p.sum(-1)
         o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vh_cur.dtype), vh_cur
-        ).astype(jnp.float32)
+            "bhqk,bhkd->bhqd", p, vh_cur.astype(jnp.float32)
+        )
         kh_next = jax.lax.ppermute(kh_cur, axis_name, perm)
         vh_next = jax.lax.ppermute(vh_cur, axis_name, perm)
         return (o_new, m_new, l_new, kh_next, vh_next), None
@@ -109,7 +115,10 @@ def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = Tru
     assert t % block_size == 0, (t, block_size)
     n_blocks = t // block_size
     scale = hd**-0.5
-    qh = qh * scale
+    # f32 recurrence math — same backend NaN workaround as ring_attention.
+    # k/v keep their storage dtype in the scan xs (no full-sequence f32
+    # copy on the memory-savings path) and are upcast per block.
+    qh = qh.astype(jnp.float32) * scale
     kb = kh.reshape(b, h, n_blocks, block_size, hd)
     vb = vh.reshape(b, h, n_blocks, block_size, hd)
     q_pos = jnp.arange(t)
@@ -117,7 +126,9 @@ def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = Tru
     def body(carry, blk):
         o, m, l = carry
         k_blk, v_blk, blk_idx = blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, k_blk).astype(jnp.float32)
+        k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, k_blk)
         if causal:
             k_pos = blk_idx * block_size + jnp.arange(block_size)
             keep = q_pos[:, None] >= k_pos[None, :]
@@ -128,9 +139,7 @@ def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = Tru
         if causal:
             p = jnp.where(keep[None, None], p, 0.0)
         l_new = l * corr + p.sum(-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk
-        ).astype(jnp.float32)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
         return (o_new, m_new, l_new), None
 
     zero = (0.0 * qh.sum()).astype(jnp.float32)  # see ring_attention

@@ -117,12 +117,30 @@ class Experiment:
             self._client_sharding = None
             self.n_chips = 1
 
-        # dataset bytes go to HBM exactly once (replicated over lanes);
-        # multi-host runs assemble global arrays from the host-replicated
-        # copies instead of device_put-ing across processes
+        # Training-corpus placement (SURVEY.md §2 C10 at scale):
+        #   hbm    — dataset bytes go to HBM exactly once (replicated over
+        #            lanes); rounds gather on device. Default.
+        #   stream — corpus stays in host RAM; each round uploads only a
+        #            fixed-size slab of the cohort's examples with the
+        #            index tensors remapped into it (max slab rows =
+        #            cohort × cap + 1). Unlocks corpora larger than HBM;
+        #            the per-round working set still must fit.
+        # Multi-host runs assemble global arrays from the host-replicated
+        # copies instead of device_put-ing across processes.
         put = self._put_data
-        self.train_x = put(jnp.asarray(self.fed.train_x))
-        self.train_y = put(jnp.asarray(self.fed.train_y))
+        self._stream = cfg.data.placement == "stream"
+        self._prefetch: Dict[int, Any] = {}
+        self._host_executor = None
+        if self._stream:
+            self._slab_rows = min(
+                cfg.server.cohort_size * self.shape.cap + 1,
+                len(self.fed.train_x),
+            )
+            self.train_x = None
+            self.train_y = None
+        else:
+            self.train_x = put(jnp.asarray(self.fed.train_x))
+            self.train_y = put(jnp.asarray(self.fed.train_y))
         self._eval_fn = jax.jit(make_eval_fn(self.model, self.task))
         # eval batches are fixed for the run: build + upload exactly once
         xb, yb, mb = eval_batches(
@@ -193,7 +211,10 @@ class Experiment:
             state["server_opt_state"] = self._put_data(state["server_opt_state"])
         return state
 
-    def _round_inputs(self, round_idx: int):
+    def _host_inputs(self, round_idx: int):
+        """All host-side work for one round: sampling, index construction,
+        dropout weights, and (stream mode) the slab gather. Pure in
+        (seed, round) — safe to run ahead on a worker thread."""
         cohort = self.sampler.sample(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
         if self._native is not None:
@@ -213,18 +234,61 @@ class Experiment:
             if not participate.any():
                 participate[host_rng.integers(len(cohort))] = True
             n_ex = n_ex * participate.astype(np.float32)
+        slab = self._stream_slab(idx) if self._stream else None
+        return cohort, idx, mask, n_ex, slab
+
+    def _round_inputs(self, round_idx: int):
+        fut = self._prefetch.pop(round_idx, None)
+        if fut is not None:
+            cohort, idx, mask, n_ex, slab = fut.result()
+        else:
+            cohort, idx, mask, n_ex, slab = self._host_inputs(round_idx)
+        if self._stream and self._host_executor is None:
+            # slab gathering is the heavy host work in stream mode; build
+            # round r+1's slab on a worker thread while the device runs r
+            # (created lazily; fit() shuts it down when the loop ends)
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._host_executor = ThreadPoolExecutor(max_workers=1)
+        nxt = round_idx + 1
+        if (self._host_executor is not None and nxt < self.cfg.server.num_rounds
+                and nxt not in self._prefetch):
+            self._prefetch[nxt] = self._host_executor.submit(self._host_inputs, nxt)
+        if slab is not None:
+            idx, slab_x, slab_y = slab
+            train_x = self._put_data(jnp.asarray(slab_x))
+            train_y = self._put_data(jnp.asarray(slab_y))
+        else:
+            train_x, train_y = self.train_x, self.train_y
         if self._cohort_sharding is not None:
             idx = self._put(idx, self._cohort_sharding)
             mask = self._put(mask, self._cohort_sharding)
             n_ex = self._put(n_ex, self._client_sharding)
-        return cohort, idx, mask, n_ex
+        return cohort, idx, mask, n_ex, train_x, train_y
+
+    def _stream_slab(self, idx: np.ndarray):
+        """Gather this round's unique example rows into a fixed-shape slab
+        (static shape ⇒ one XLA trace for the whole run) and remap the
+        index tensor into it. Tail rows past ``len(uniq)`` are left
+        uninitialized — every remapped index points below ``len(uniq)``,
+        so they are never gathered."""
+        uniq, inv = np.unique(idx, return_inverse=True)
+        assert len(uniq) <= self._slab_rows, (len(uniq), self._slab_rows)
+        slab_x = np.empty((self._slab_rows,) + self.fed.train_x.shape[1:],
+                          self.fed.train_x.dtype)
+        slab_y = np.empty((self._slab_rows,) + self.fed.train_y.shape[1:],
+                          self.fed.train_y.dtype)
+        slab_x[: len(uniq)] = self.fed.train_x[uniq]
+        slab_y[: len(uniq)] = self.fed.train_y[uniq]
+        new_idx = inv.reshape(idx.shape).astype(np.int32)
+        return new_idx, slab_x, slab_y
 
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
-        cohort, idx, mask, n_ex = self._round_inputs(round_idx)
+        cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         params, opt_state, metrics = self.round_fn(
             state["params"], state["server_opt_state"],
-            self.train_x, self.train_y, idx, mask, n_ex, rng,
+            train_x, train_y, idx, mask, n_ex, rng,
         )
         return {
             "params": params,
@@ -240,7 +304,20 @@ class Experiment:
         """Base directory for this run's artifacts; out_dir="" → cwd."""
         return os.path.join(self.cfg.run.out_dir or ".", self.cfg.name)
 
+    def _stop_prefetch(self) -> None:
+        """Shut down the stream-mode host worker (no-op otherwise)."""
+        ex, self._host_executor = self._host_executor, None
+        self._prefetch.clear()
+        if ex is not None:
+            ex.shutdown(wait=True, cancel_futures=True)
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        try:
+            return self._fit(state)
+        finally:
+            self._stop_prefetch()
+
+    def _fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         cfg = self.cfg
         store = None
         if cfg.run.out_dir:
@@ -272,6 +349,11 @@ class Experiment:
         flush_every = max(1, cfg.run.metrics_flush_every)
         if cfg.run.sanitize:
             flush_every = 1  # sanitize wants per-round finiteness checks
+        if self._stream:
+            # every dispatched-but-unexecuted round holds a full slab in
+            # HBM; cap the async backlog so stream mode's bounded-memory
+            # promise survives (≤2 dispatched + 1 prefetching)
+            flush_every = min(flush_every, 2)
         pending = []  # (round_idx, RoundMetrics-on-device)
         flush_t0 = time.perf_counter()
 

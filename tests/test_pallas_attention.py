@@ -66,10 +66,88 @@ def test_bfloat16_inputs():
     )
 
 
-def test_indivisible_block_raises():
-    q, k, v = _qkv(1, 48, 64)
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, 2, True, 32, 32)
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [48, 197, 50])
+def test_indivisible_lengths_padded_and_masked(causal, t):
+    """Non-divisible T (e.g. ViT's 197 tokens) pads up to a block multiple;
+    masked padded keys must not leak into real rows — exact parity."""
+    q, k, v = _qkv(1, t, 64, seed=5)
+    oracle = causal_attention if causal else full_attention
+    want = oracle(q, k, v, 2)
+    got = flash_attention(q, k, v, 2, causal, 32, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_indivisible_gradients_match(causal):
+    """Causal ragged T exercises the zero-padded blockwise recompute;
+    non-causal ragged T the full-attention fallback."""
+    q, k, v = _qkv(1, 50, 64, seed=6)
+    g = jax.random.normal(jax.random.PRNGKey(11), (1, 50, 64))
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) * g).sum()
+
+    oracle = causal_attention if causal else full_attention
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(q, k, v, 2, causal, 32, 32)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: oracle(q, k, v, 2)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_lm_gradients_finite():
+    """Regression: with bf16 compute, the blockwise/pallas backends'
+    gradients inside the full LM graph NaN'd on the TPU backend (bf16
+    einsums fused into the scan backward); the recurrence now computes in
+    f32 internally. Values were always fine in isolation — the graph
+    context matters, hence this in-model test."""
+    from colearn_federated_learning_tpu.client.trainer import make_loss_fn
+    from colearn_federated_learning_tpu.models import build_model, init_params
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 50, (8, 32)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 50, (8, 32)).astype(np.int32))
+    m1 = jnp.ones((8,), jnp.float32)
+    for attention in ("blockwise", "pallas"):
+        model = build_model("bert_tiny", 0, vocab_size=50, seq_len=32,
+                            attention=attention, block_size=8,
+                            compute_dtype=jnp.bfloat16)
+        params = init_params(model, (32,), seed=0, input_dtype=jnp.int32)
+        loss_fn = make_loss_fn(model, "lm")
+        l, g = jax.jit(jax.value_and_grad(loss_fn))(params, x, y, m1)
+        assert np.isfinite(float(l))
+        for t in jax.tree.leaves(g):
+            assert np.isfinite(np.asarray(t, np.float32)).all(), attention
+
+
+def test_bert_builder_honors_geometry_kwargs():
+    """Regression: layers/hidden/heads/ff were silently swallowed."""
+    from colearn_federated_learning_tpu.models import build_model, init_params
+
+    model = build_model("bert_tiny", 0, vocab_size=50, seq_len=16,
+                        hidden=64, heads=4, layers=3, ff=128)
+    params = init_params(model, (16,), seed=0, input_dtype=jnp.int32)
+    assert "TransformerBlock_2" in params and "TransformerBlock_3" not in params
+    assert params["TransformerBlock_0"]["Dense_0"]["kernel"].shape == (64, 192)
+
+
+def test_vit_pallas_backend_matches_full():
+    from colearn_federated_learning_tpu.models import build_model, init_params
+
+    kwargs = dict(image_size=32, patch_size=8, hidden=64, layers=2, heads=2,
+                  mlp_dim=128)  # 17 tokens: exercises the padded path
+    m_full = build_model("vit_b16", 10, attention="full", **kwargs)
+    m_pal = build_model("vit_b16", 10, attention="pallas", block_size=16, **kwargs)
+    params = init_params(m_full, (32, 32, 3), seed=0)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 32, 32, 3))
+    want = m_full.apply({"params": params}, x, train=False)
+    got = m_pal.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_bert_tiny_pallas_backend_matches_full():
