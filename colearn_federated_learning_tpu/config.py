@@ -130,6 +130,9 @@ class RunConfig:
     # them every N rounds. 1 = fetch every round (debug).
     metrics_flush_every: int = 10
     out_dir: str = "runs"
+    # also mirror per-round metrics as TensorBoard scalars under
+    # <out_dir>/<name>/tb (JSONL is always written)
+    tensorboard: bool = False
     resume: bool = False
     profile_round: int = -1  # round index to wrap in jax.profiler.trace; -1 = off
     sanitize: bool = False  # jax_debug_nans + finite-params assertions
@@ -338,7 +341,9 @@ def _imagenet_silo_dp() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=64, lr=0.003, optimizer="adamw"),
         server=ServerConfig(num_rounds=100, cohort_size=32, eval_every=5),
-        dp=DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=0.8, microbatch_size=8),
+        # microbatch 16: measured ~5% faster than 8 on v5e at 224px; 32 is
+        # marginally faster still but near the compile/memory ceiling
+        dp=DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=0.8, microbatch_size=16),
         run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 

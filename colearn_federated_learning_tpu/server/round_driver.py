@@ -148,7 +148,8 @@ class Experiment:
         )
         self._eval_data = (put(jnp.asarray(xb)), put(jnp.asarray(yb)), put(jnp.asarray(mb)))
         self.logger = MetricsLogger(cfg.run.out_dir or None, cfg.name, echo=echo,
-                                    append=cfg.run.resume)
+                                    append=cfg.run.resume,
+                                    tensorboard=cfg.run.tensorboard)
 
         # Host-side round-input construction: the C++ threaded pipeline
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
@@ -316,6 +317,8 @@ class Experiment:
             return self._fit(state)
         finally:
             self._stop_prefetch()
+            # flush + join the TensorBoard writer thread (no-op without TB)
+            self.logger.close()
 
     def _fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         cfg = self.cfg

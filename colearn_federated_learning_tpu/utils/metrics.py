@@ -17,16 +17,54 @@ from typing import Any, Dict, Optional
 
 class MetricsLogger:
     def __init__(self, out_dir: Optional[str], run_name: str, echo: bool = True,
-                 append: bool = False):
+                 append: bool = False, tensorboard: bool = False):
         self.echo = echo
         self.path = None
+        self._tb = None
+        self._tb_dir = None
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
             self.path = os.path.join(out_dir, f"{run_name}.metrics.jsonl")
             if not append:
                 # one file per fresh run; resumed runs keep prior rounds
                 open(self.path, "w").close()
+            if tensorboard:
+                self._tb_dir = os.path.join(out_dir, run_name, "tb")
         self.history = []
+
+    def _open_tensorboard(self) -> None:
+        # Opened lazily on the first scalar so evaluate-only runs (which
+        # construct the logger but never log rounds) don't accumulate
+        # empty event files, and a close()d logger can reopen on the next
+        # fit. The event-file writer ships with the tensorboard package
+        # itself (no TensorFlow needed); scalars mirror the JSONL records.
+        try:
+            from tensorboard.summary.writer.event_file_writer import (
+                EventFileWriter,
+            )
+
+            os.makedirs(self._tb_dir, exist_ok=True)
+            self._tb = EventFileWriter(self._tb_dir)
+        except Exception as e:  # missing/broken package: JSONL still works
+            print(f"tensorboard logging disabled: {e}", flush=True)
+            self._tb_dir = None
+
+    def _tb_scalars(self, record: Dict[str, Any]) -> None:
+        from tensorboard.compat.proto.event_pb2 import Event
+        from tensorboard.compat.proto.summary_pb2 import Summary
+
+        step = int(record["round"])
+        values = [
+            Summary.Value(tag=k, simple_value=float(v))
+            for k, v in record.items()
+            if k not in ("round", "time") and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+        ]
+        if values:
+            self._tb.add_event(
+                Event(wall_time=record["time"], step=step,
+                      summary=Summary(value=values))
+            )
 
     def log(self, record: Dict[str, Any]):
         record = dict(record, time=time.time())
@@ -34,8 +72,19 @@ class MetricsLogger:
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(record) + "\n")
+        if self._tb_dir is not None and "round" in record:
+            if self._tb is None:
+                self._open_tensorboard()
+            if self._tb is not None:
+                self._tb_scalars(record)
         if self.echo:
             shown = {k: v for k, v in record.items() if k != "time"}
             print(json.dumps(shown), flush=True)
+
+    def close(self):
+        tb, self._tb = self._tb, None
+        if tb is not None:
+            tb.flush()
+            tb.close()
 
 

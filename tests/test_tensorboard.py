@@ -1,0 +1,70 @@
+"""run.tensorboard=true mirrors the JSONL metrics as TB scalar events
+(SURVEY.md §5 metrics/observability: "JSONL + optional TensorBoard")."""
+
+import glob
+import struct
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _read_events(path):
+    """Minimal TFRecord reader: [len u64][len_crc u32][data][data_crc u32]."""
+    from tensorboard.compat.proto.event_pb2 import Event
+
+    events = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            f.read(4)
+            data = f.read(length)
+            f.read(4)
+            e = Event()
+            e.ParseFromString(data)
+            events.append(e)
+    return events
+
+
+def test_tensorboard_scalars_written(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": 3,
+        "server.eval_every": 3,
+        "data.synthetic_train_size": 128,
+        "data.synthetic_test_size": 32,
+        "run.out_dir": str(tmp_path),
+        "run.tensorboard": True,
+        "run.metrics_flush_every": 1,
+    })
+    exp = Experiment(cfg, echo=False)
+    exp.fit()
+
+    files = glob.glob(str(tmp_path / cfg.name / "tb" / "events.out.tfevents.*"))
+    assert files, "no TB event file written"
+    events = _read_events(files[0])
+    scalars = {}
+    for e in events:
+        for v in e.summary.value:
+            scalars.setdefault(v.tag, []).append((e.step, v.simple_value))
+    assert len(scalars.get("train_loss", [])) == 3
+    assert [s for s, _ in scalars["train_loss"]] == [1, 2, 3]
+    assert "eval_acc" in scalars
+
+
+def test_evaluate_only_writes_no_event_files(tmp_path):
+    """The writer opens lazily: constructing an Experiment (e.g. for
+    `colearn evaluate`) with tensorboard on must not spawn event files."""
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "data.synthetic_train_size": 128,
+        "data.synthetic_test_size": 32,
+        "run.out_dir": str(tmp_path),
+        "run.tensorboard": True,
+    })
+    exp = Experiment(cfg, echo=False)
+    state = exp.init_state()
+    exp.evaluate(exp._place_state(state)["params"])
+    assert not glob.glob(str(tmp_path / cfg.name / "tb" / "*"))
