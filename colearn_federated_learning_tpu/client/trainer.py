@@ -123,8 +123,16 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
             lambda p: jax.lax.pcast(p, (batch_axis,), to="varying"), tree
         )
 
-    def local_train(global_params, train_x, train_y, idx, mask, rng):
-        """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics)."""
+    def local_train(global_params, train_x, train_y, idx, mask, rng,
+                    lr_scale=None):
+        """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics).
+
+        ``lr_scale``: optional traced scalar multiplying every optimizer
+        update — the round-indexed client LR decay (client.lr_decay).
+        Scaling the final update is exactly scaling the learning rate for
+        both sgd(+momentum) and adamw (optax applies lr as the last
+        scale).
+        """
         if local_dtype is not None:
             global_params = jax.tree.map(
                 lambda p: p.astype(local_dtype), global_params
@@ -155,6 +163,10 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
                     lambda g, p, p0: g + mu * (p - p0), grads, params, global_params
                 )
             updates, new_opt_state = opt.update(grads, opt_state, params)
+            if lr_scale is not None:
+                updates = jax.tree.map(
+                    lambda u: u * lr_scale.astype(u.dtype), updates
+                )
             new_params = optax.apply_updates(params, updates)
             # validity must be judged on the GLOBAL mask so batch shards
             # never diverge on whether a padded step applied

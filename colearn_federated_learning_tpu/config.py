@@ -60,6 +60,10 @@ class ClientConfig:
     batch_size: int = 32
     optimizer: str = "sgd"  # sgd | adamw
     lr: float = 0.1
+    # per-round multiplicative LR decay: round r trains at lr·decay^r
+    # (1.0 = constant). Computed inside the compiled round program from
+    # the server state's round counter — no retracing.
+    lr_decay: float = 1.0
     momentum: float = 0.9
     weight_decay: float = 0.0
     # FedProx proximal coefficient μ (0.0 == plain FedAvg local training)

@@ -50,6 +50,12 @@ class RoundMetrics(NamedTuple):
     examples: jnp.ndarray  # total real examples processed
 
 
+def _decay_scale(decay: float, server_opt_state):
+    """lr multiplier decay^round from the server state's round counter."""
+    r = server_opt_state["round"].astype(jnp.float32)
+    return jnp.power(jnp.float32(decay), r)
+
+
 def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           cohort_size: int, donate: bool = True,
                           client_vmap_width: int = 1, local_dtype=None,
@@ -108,18 +114,22 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
+    use_decay = client_cfg.lr_decay != 1.0
 
-    def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys):
+    def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
         # Mark params as device-varying so scan carries (which mix in
         # per-lane data) type-check under shard_map's vma system.
+        lr_scale = rest[0] if rest else None
         params = _pcast_varying(params)
 
         def per_block(acc, inp):
             b_idx, b_mask, b_n, b_keys = inp  # leading axis: width (vmapped)
+            extra = () if lr_scale is None else (lr_scale,)
             w_b, m_b = jax.vmap(
-                local_train, in_axes=(None, None, None, 0, 0, 0)
-            )(params, train_x, train_y, b_idx, b_mask, b_keys)
+                local_train,
+                in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
+            )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
             # FedAvg weight per client: example count, or participation
             # (n>0) under "uniform" — dropout zeroing propagates either way
             b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
@@ -164,18 +174,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     cohort_spec = (
         P(CLIENT_AXIS, None, BATCH_AXIS) if batch_sharded else P(CLIENT_AXIS)
     )
+    in_specs = (P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS))
+    if use_decay:
+        in_specs += (P(),)  # lr_scale scalar, replicated
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        in_specs=in_specs,
         out_specs=(P(), P(), P()),
     )
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
         keys = jax.random.split(rng, idx.shape[0])
+        extra = ()
+        if use_decay:
+            # round-indexed client LR decay, derived inside the program
+            # from the server state's round counter (aggregation.py)
+            extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
         mean_delta, n_total, mean_loss = sharded_lane(
-            params, train_x, train_y, idx, mask, n_ex, keys
+            params, train_x, train_y, idx, mask, n_ex, keys, *extra
         )
         new_params, new_opt_state = server_update(params, server_opt_state, mean_delta)
         return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
@@ -195,12 +213,19 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                                               local_dtype=local_dtype))
     update = jax.jit(server_update)
 
+    use_decay = client_cfg.lr_decay != 1.0
+
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
+        extra = (
+            (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            if use_decay else ()
+        )
         deltas, weights, losses = [], [], []
         for c in range(k):
-            w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c], keys[c])
+            w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
+                                   keys[c], *extra)
             deltas.append(trees.tree_sub(w_i, params))
             n_c = jnp.asarray(n_ex[c])
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))

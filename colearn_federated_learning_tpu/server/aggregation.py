@@ -43,16 +43,26 @@ def make_server_update_fn(cfg: ServerConfig):
     """(params, opt_state, mean_delta) → (new_params, new_opt_state).
 
     Feeds ``−Δ̄`` to optax as the gradient, so every optax transform is a
-    valid server optimizer.
+    valid server optimizer. The state carries a monotone round counter
+    (``"round"``) alongside the optax state — the round engine reads it
+    to compute round-indexed schedules (client LR decay) *inside* the
+    compiled program, so schedules need no extra traced inputs.
+
+    Format note: the ``{"round", "opt"}`` wrapper was introduced in
+    round 2 of this build — checkpoints written by earlier builds (raw
+    optax state) are not restorable against the current template. No
+    migration shim is shipped: there are no deployed checkpoints of the
+    old format (run artifacts were never part of the repo).
     """
     opt = make_server_optimizer(cfg)
 
     def init(params) -> Any:
-        return opt.init(params)
+        return {"round": jnp.zeros((), jnp.int32), "opt": opt.init(params)}
 
     def update(params, opt_state, mean_delta) -> Tuple[Any, Any]:
         pseudo_grad = jax.tree.map(jnp.negative, mean_delta)
-        updates, opt_state = opt.update(pseudo_grad, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+        updates, new_opt = opt.update(pseudo_grad, opt_state["opt"], params)
+        new_state = {"round": opt_state["round"] + 1, "opt": new_opt}
+        return optax.apply_updates(params, updates), new_state
 
     return init, update
