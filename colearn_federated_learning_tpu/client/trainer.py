@@ -98,8 +98,20 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
     pure-bf16 while server-side aggregation and the cross-round parameter
     trajectory stay f32. Returned params are in ``local_dtype``; the
     aggregator's delta math upcasts back to f32.
+
+    Padded-step gating: for ``sgd`` (the FL workhorse) validity is folded
+    into *scalars* instead of per-leaf ``where`` selects — the update is
+    ``m ← β_eff·m + v·g;  p ← p − lr_eff·m`` with ``v = [step valid]``,
+    ``β_eff = 1 − v(1−β)`` and ``lr_eff = v·lr``, which is algebraically
+    identical to select-gating (v=1 ⇒ plain momentum SGD; v=0 ⇒ both m
+    and p unchanged) but fuses into the existing FMAs. The profile in
+    BASELINE.md measured the select version's ``broadcast_select``
+    fusions at ~11% of round device time. ``adamw`` keeps the general
+    optax + select path (its count/bias-correction state isn't scalar-
+    gateable).
     """
-    opt = make_client_optimizer(client_cfg)
+    fused_sgd = client_cfg.optimizer == "sgd"
+    opt = None if fused_sgd else make_client_optimizer(client_cfg)
     grad_fn = jax.value_and_grad(make_loss_fn(model, task))
     sum_grad_fn = jax.value_and_grad(make_loss_fn(model, task, reduction="sum"))
     mu = client_cfg.prox_mu
@@ -124,7 +136,7 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
         )
 
     def local_train(global_params, train_x, train_y, idx, mask, rng,
-                    lr_scale=None):
+                    lr_scale=None, grad_corr=None):
         """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics).
 
         ``lr_scale``: optional traced scalar multiplying every optimizer
@@ -132,6 +144,12 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
         Scaling the final update is exactly scaling the learning rate for
         both sgd(+momentum) and adamw (optax applies lr as the last
         scale).
+
+        ``grad_corr``: optional params-shaped tree added to every step's
+        gradient — SCAFFOLD's variance-reduction term (c − cᵢ), constant
+        over the local phase (Karimireddy et al. 2020, eq. 4). Padded
+        steps stay exact no-ops: the correction rides the same validity
+        gate as the gradient.
         """
         if local_dtype is not None:
             global_params = jax.tree.map(
@@ -162,17 +180,48 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
                 grads = jax.tree.map(
                     lambda g, p, p0: g + mu * (p - p0), grads, params, global_params
                 )
-            updates, new_opt_state = opt.update(grads, opt_state, params)
-            if lr_scale is not None:
-                updates = jax.tree.map(
-                    lambda u: u * lr_scale.astype(u.dtype), updates
+            if grad_corr is not None:
+                grads = jax.tree.map(
+                    lambda g, cc: g + cc.astype(g.dtype), grads, grad_corr
                 )
-            new_params = optax.apply_updates(params, updates)
             # validity must be judged on the GLOBAL mask so batch shards
             # never diverge on whether a padded step applied
-            valid = step_n > 0
-            params = _select_tree(valid, new_params, params)
-            opt_state = _select_tree(valid, new_opt_state, opt_state)
+            if fused_sgd:
+                v = (step_n > 0).astype(jnp.float32)
+                wd = client_cfg.weight_decay
+                if wd:
+                    grads = jax.tree.map(
+                        lambda g, p: g + jnp.asarray(wd, g.dtype) * p.astype(g.dtype),
+                        grads, params,
+                    )
+                lr_eff = jnp.float32(client_cfg.lr) * v
+                if lr_scale is not None:
+                    lr_eff = lr_eff * lr_scale.astype(lr_eff.dtype)
+                beta = client_cfg.momentum
+                if beta:
+                    beta_eff = 1.0 - v * (1.0 - beta)
+                    opt_state = jax.tree.map(
+                        lambda m_, g: beta_eff.astype(m_.dtype) * m_
+                        + v.astype(g.dtype) * g.astype(m_.dtype),
+                        opt_state, grads,
+                    )
+                    direction = opt_state
+                else:
+                    direction = grads
+                params = jax.tree.map(
+                    lambda p, d: p - lr_eff.astype(p.dtype) * d.astype(p.dtype),
+                    params, direction,
+                )
+            else:
+                updates, new_opt_state = opt.update(grads, opt_state, params)
+                if lr_scale is not None:
+                    updates = jax.tree.map(
+                        lambda u: u * lr_scale.astype(u.dtype), updates
+                    )
+                new_params = optax.apply_updates(params, updates)
+                valid = step_n > 0
+                params = _select_tree(valid, new_params, params)
+                opt_state = _select_tree(valid, new_opt_state, opt_state)
             return (params, opt_state), loss * step_n
 
         steps = idx.shape[0]
@@ -184,9 +233,16 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
         # sequential engine — same trick as privacy/dp.py's accumulators.
         # Under a batch axis the tie-in must be the psummed count, which is
         # batch-invariant like the params carry itself.
+        if fused_sgd:
+            # momentum buffer (or nothing) — the whole optimizer state
+            base_state = (
+                trees.tree_zeros_like(global_params) if client_cfg.momentum else ()
+            )
+        else:
+            base_state = opt.init(global_params)
         vary0 = 0.0 * _global_count(mask)
         opt_state0 = jax.tree.map(
-            lambda x: x + vary0.astype(x.dtype), opt.init(global_params)
+            lambda x: x + vary0.astype(x.dtype), base_state
         )
         (params, _), weighted_losses = jax.lax.scan(
             step, (global_params, opt_state0), (idx, mask, keys)

@@ -77,7 +77,7 @@ class ServerConfig:
     eval_every: int = 1
     checkpoint_every: int = 0  # 0 = only at end
     # Server-side optimizer applied to the aggregated delta:
-    #   mean (plain FedAvg) | fedavgm (server momentum) | fedadam
+    #   mean (plain FedAvg) | fedavgm (server momentum) | fedadam | fedyogi
     optimizer: str = "mean"
     server_lr: float = 1.0
     server_momentum: float = 0.9
@@ -154,7 +154,9 @@ class RunConfig:
 @dataclass
 class ExperimentConfig:
     name: str = "mnist_fedavg_2"
-    algorithm: str = "fedavg"  # fedavg | fedprox (prox_mu>0 implied)
+    # fedavg | fedprox (prox_mu>0 implied) | scaffold (client control
+    # variates, Karimireddy et al. 2020 — needs plain client SGD)
+    algorithm: str = "fedavg"
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
@@ -169,8 +171,33 @@ class ExperimentConfig:
             )
         if self.algorithm == "fedprox" and self.client.prox_mu <= 0:
             raise ValueError("fedprox requires client.prox_mu > 0")
-        if self.algorithm not in ("fedavg", "fedprox"):
+        if self.algorithm not in ("fedavg", "fedprox", "scaffold"):
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.algorithm == "scaffold":
+            # the option-II control-variate identity cᵢ⁺ = (w₀−w_K)/(K·lr)
+            # assumes plain SGD local steps (Karimireddy et al. 2020 §3);
+            # momentum breaks it, and DP noise would leak into cᵢ state
+            if self.client.optimizer != "sgd" or self.client.momentum != 0.0:
+                raise ValueError(
+                    "scaffold requires client.optimizer=sgd with momentum=0"
+                )
+            if self.client.prox_mu > 0.0:
+                # the proximal pull μ(w−w₀) is anchored to the ROUND's w₀,
+                # so (w₀−w_K)/(K·lr) would bake a round-local term into the
+                # persistent cᵢ. (weight_decay is fine: identical across
+                # clients, it enters every cᵢ equally and cancels in c−cᵢ.)
+                raise ValueError("scaffold is incompatible with client.prox_mu > 0")
+            if self.dp.enabled:
+                raise ValueError("scaffold is incompatible with dp.enabled")
+            if self.run.local_param_dtype not in ("", "float32"):
+                # cᵢ⁺ divides (w₀−w_K) by K·lr; low-precision w_K bakes
+                # its rounding error (amplified ~1/(K·lr)) into the
+                # PERSISTENT control variates, which then re-enter every
+                # local gradient — keep local training f32 under scaffold
+                raise ValueError(
+                    "scaffold requires f32 local training "
+                    "(run.local_param_dtype='' or 'float32')"
+                )
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in ("uniform", "weighted"):

@@ -56,10 +56,35 @@ def _decay_scale(decay: float, server_opt_state):
     return jnp.power(jnp.float32(decay), r)
 
 
+def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
+    """SCAFFOLD option-II control-variate update over a client block.
+
+    ``cᵢ⁺ = cᵢ + (w₀ − w_K)/(Kᵢ·lr) − c`` for participants, ``cᵢ`` for
+    non-participants — the participation gate ``part`` folds into the
+    per-client scales so the non-participant case is exact. All leaves
+    ``[width, ...]``; ``k_valid``/``part`` are ``[width]`` vectors;
+    SHARED by the sharded lane and the sequential oracle so the two
+    engines stay definitionally identical. Math in f32 regardless of
+    the local-training dtype."""
+    inv = part / (jnp.maximum(k_valid, 1.0) * lr_i)
+
+    def leaf(ci, cg, w0, wk):
+        bshape = (ci.shape[0],) + (1,) * (ci.ndim - 1)
+        return (
+            ci
+            + (w0[None].astype(jnp.float32) - wk.astype(jnp.float32))
+            * inv.reshape(bshape)
+            - part.reshape(bshape) * cg
+        )
+
+    return jax.tree.map(leaf, b_c, c_global, params, w_b)
+
+
 def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           cohort_size: int, donate: bool = True,
                           client_vmap_width: int = 1, local_dtype=None,
-                          agg: str = "examples"):
+                          agg: str = "examples", scaffold: bool = False,
+                          num_clients: int = 0):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -88,6 +113,19 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     (raises otherwise — never silently rewritten). Peak memory scales
     with width (one activation set per vmapped client), so big-model
     configs keep it low.
+
+    ``scaffold``: SCAFFOLD control variates (Karimireddy et al. 2020,
+    option II). The round fn takes two extra trailing inputs —
+    ``c_global`` (replicated params-shaped tree) and ``c_cohort``
+    (client-sharded ``[K, ...]`` stacked tree of the cohort's cᵢ) — and
+    returns ``(params, opt_state, new_c_global, new_c_cohort, metrics)``.
+    Per step the client gradient gets ``+ (c − cᵢ)``; afterwards
+    ``cᵢ⁺ = cᵢ − c + (w₀ − w_K)/(K·lr)`` (the option-II identity:
+    exactly the client's average applied local gradient), and
+    ``c ← c + Σᵢ Δcᵢ / num_clients``. Requires plain client SGD
+    (momentum breaks the identity — config.validate enforces it);
+    non-participating clients (dropout / empty shards) keep cᵢ and
+    contribute zero Δc. All c math is f32 regardless of local dtype.
     """
     batch_sharded = has_batch_axis(mesh)
     if batch_sharded and client_cfg.batch_size % mesh.shape[BATCH_AXIS]:
@@ -114,26 +152,41 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
+    if scaffold and num_clients <= 0:
+        raise ValueError("scaffold requires num_clients (for the c update)")
     use_decay = client_cfg.lr_decay != 1.0
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
         # Mark params as device-varying so scan carries (which mix in
         # per-lane data) type-check under shard_map's vma system.
-        lr_scale = rest[0] if rest else None
+        rest = list(rest)
+        lr_scale = rest.pop(0) if use_decay else None
+        c_global, c_cohort = (rest.pop(0), rest.pop(0)) if scaffold else (None, None)
         params = _pcast_varying(params)
+        if scaffold:
+            c_global = _pcast_varying(c_global)
 
         def per_block(acc, inp):
-            b_idx, b_mask, b_n, b_keys = inp  # leading axis: width (vmapped)
-            extra = () if lr_scale is None else (lr_scale,)
-            w_b, m_b = jax.vmap(
-                local_train,
-                in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
-            )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
+            if scaffold:
+                b_idx, b_mask, b_n, b_keys, b_c = inp
+                # SCAFFOLD correction (c − cᵢ), constant over the local
+                # phase; f32 leaf broadcast [..] − [width, ..]
+                corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, b_c)
+                w_b, m_b = jax.vmap(
+                    local_train, in_axes=(None, None, None, 0, 0, 0, None, 0),
+                )(params, train_x, train_y, b_idx, b_mask, b_keys, lr_scale, corr)
+            else:
+                b_idx, b_mask, b_n, b_keys = inp  # leading axis: width
+                extra = () if lr_scale is None else (lr_scale,)
+                w_b, m_b = jax.vmap(
+                    local_train,
+                    in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
+                )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
             # FedAvg weight per client: example count, or participation
             # (n>0) under "uniform" — dropout zeroing propagates either way
             b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
-            d_acc, w_acc, n_acc, l_acc = acc
+            d_acc, w_acc, n_acc, l_acc, dc_acc = acc
             # Σ over the block of w_i·(Δ_i), fused as one contraction;
             # delta math in the ACCUMULATOR dtype (f32 server params):
             # bf16 local weights upcast here, so client-side mixed
@@ -146,19 +199,48 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 ).astype(a.dtype),
                 d_acc, w_b, params,
             )
+            new_c_block = None
+            if scaffold:
+                # Kᵢ = # non-padded steps, counted on the GLOBAL mask so
+                # batch shards agree on validity (same rule as the
+                # trainer's _global_count — a step whose valid examples
+                # all sit on another batch shard is still a real step)
+                step_counts = b_mask.sum(-1)  # [width, steps] (this shard)
+                if batch_sharded:
+                    step_counts = jax.lax.psum(step_counts, BATCH_AXIS)
+                k_valid = (step_counts > 0).sum(-1).astype(jnp.float32)
+                lr_i = jnp.float32(client_cfg.lr)
+                if lr_scale is not None:
+                    lr_i = lr_i * lr_scale.astype(jnp.float32)
+                part = ((b_n > 0) & (k_valid > 0)).astype(jnp.float32)
+                new_c_block = _scaffold_c_update(
+                    b_c, c_global, params, w_b, k_valid, lr_i, part
+                )
+                dc_acc = jax.tree.map(
+                    lambda a, nc, ci: a + (nc - ci).sum(0), dc_acc, new_c_block, b_c
+                )
             return (d_acc, w_acc + b_w.sum(), n_acc + b_n.sum(),
-                    l_acc + (b_w * m_b.loss).sum()), None
+                    l_acc + (b_w * m_b.loss).sum(), dc_acc), new_c_block
 
         n_blocks = idx.shape[0] // width
+        scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if scaffold else ())
         blocked = jax.tree.map(
-            lambda a: a.reshape((n_blocks, width) + a.shape[1:]),
-            (idx, mask, n_ex, keys),
+            lambda a: a.reshape((n_blocks, width) + a.shape[1:]), scan_in
+        )
+        # dc accumulates f32 c-variate deltas regardless of params dtype
+        # (the "all c math is f32" invariant — and the scan carry must
+        # match the f32 per-block increment)
+        dc0 = (
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if scaffold else jnp.zeros(())
         )
         acc0 = _pcast_varying(
             (trees.tree_zeros_like(params),
-             jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+             jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), dc0),
         )
-        (d_sum, w_sum, n_sum, l_sum), _ = jax.lax.scan(per_block, acc0, blocked)
+        (d_sum, w_sum, n_sum, l_sum, dc_sum), new_c = jax.lax.scan(
+            per_block, acc0, blocked
+        )
         # The aggregation collective — the reference's NCCL allreduce
         # (BASELINE.json:5) as a single XLA psum over the ICI.
         d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
@@ -167,6 +249,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
         denom = jnp.maximum(w_sum, 1.0)
         mean_delta = trees.tree_scale(d_sum, 1.0 / denom)
+        if scaffold:
+            dc_sum = jax.lax.psum(dc_sum, CLIENT_AXIS)
+            new_c = jax.tree.map(
+                lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), new_c
+            )
+            return mean_delta, n_sum, l_sum / denom, dc_sum, new_c
         return mean_delta, n_sum, l_sum / denom
 
     # [K, steps, batch] index/mask tensors additionally shard the batch
@@ -177,12 +265,39 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     in_specs = (P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS))
     if use_decay:
         in_specs += (P(),)  # lr_scale scalar, replicated
+    if scaffold:
+        in_specs += (P(), P(CLIENT_AXIS))  # c_global, c_cohort
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(CLIENT_AXIS)) if scaffold else (P(), P(), P()),
     )
+
+    if scaffold:
+
+        @partial(jax.jit, donate_argnums=(0, 1, 8, 9) if donate else ())
+        def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
+                     n_ex, rng, c_global, c_cohort):
+            keys = jax.random.split(rng, idx.shape[0])
+            extra = ()
+            if use_decay:
+                extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            mean_delta, n_total, mean_loss, dc_sum, new_c_cohort = sharded_lane(
+                params, train_x, train_y, idx, mask, n_ex, keys,
+                *extra, c_global, c_cohort,
+            )
+            new_params, new_opt_state = server_update(
+                params, server_opt_state, mean_delta
+            )
+            # c ← c + (1/N)·Σᵢ∈S Δcᵢ  (paper's |S|/N · mean over S)
+            new_c_global = jax.tree.map(
+                lambda c, dc: c + dc / float(num_clients), c_global, dc_sum
+            )
+            return (new_params, new_opt_state, new_c_global, new_c_cohort,
+                    RoundMetrics(mean_loss, n_total))
+
+        return round_fn
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
@@ -202,30 +317,67 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
 
 def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
-                             local_dtype=None, agg: str = "examples"):
+                             local_dtype=None, agg: str = "examples",
+                             scaffold: bool = False, num_clients: int = 0):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
-    engine is tested against (SURVEY.md §4.3)."""
+    engine is tested against (SURVEY.md §4.3). ``scaffold`` mirrors the
+    sharded engine's control-variate signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
+    if scaffold and num_clients <= 0:
+        raise ValueError("scaffold requires num_clients (for the c update)")
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
                                               local_dtype=local_dtype))
     update = jax.jit(server_update)
 
     use_decay = client_cfg.lr_decay != 1.0
 
-    def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng):
+    def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
+                 c_global=None, c_cohort=None):
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
-        extra = (
-            (_decay_scale(client_cfg.lr_decay, server_opt_state),)
-            if use_decay else ()
+        lr_scale = (
+            _decay_scale(client_cfg.lr_decay, server_opt_state)
+            if use_decay else None
         )
+        extra = (lr_scale,) if use_decay else ()
         deltas, weights, losses = [], [], []
+        new_cs = []
+        dc_sum = (
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if scaffold else None
+        )
         for c in range(k):
-            w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
-                                   keys[c], *extra)
+            if scaffold:
+                c_i = jax.tree.map(lambda a: a[c], c_cohort)
+                corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, c_i)
+                w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
+                                       keys[c], lr_scale, corr)
+                # width-1 block through the SAME update helper as the
+                # sharded lane — the oracle can't drift from the engine
+                k_valid = jnp.asarray(
+                    [(jnp.asarray(mask[c]).sum(-1) > 0).sum()], jnp.float32
+                )
+                lr_i = jnp.float32(client_cfg.lr) * (
+                    lr_scale.astype(jnp.float32) if lr_scale is not None else 1.0
+                )
+                part = ((jnp.asarray(n_ex[c]) > 0) & (k_valid[0] > 0)).astype(
+                    jnp.float32
+                )[None]
+                new_c_block = _scaffold_c_update(
+                    jax.tree.map(lambda a: a[None], c_i), c_global, params,
+                    jax.tree.map(lambda a: a[None], w_i), k_valid, lr_i, part,
+                )
+                new_c = jax.tree.map(lambda a: a[0], new_c_block)
+                new_cs.append(new_c)
+                dc_sum = jax.tree.map(
+                    lambda a, nc, ci: a + (nc - ci), dc_sum, new_c, c_i
+                )
+            else:
+                w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
+                                       keys[c], *extra)
             deltas.append(trees.tree_sub(w_i, params))
             n_c = jnp.asarray(n_ex[c])
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
@@ -238,6 +390,15 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         mean_delta = trees.tree_scale(acc, 1.0 / denom)
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
         new_params, new_opt_state = update(params, server_opt_state, mean_delta)
+        if scaffold:
+            new_c_global = jax.tree.map(
+                lambda cg, dc: cg + dc / float(num_clients), c_global, dc_sum
+            )
+            new_c_cohort = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *new_cs
+            )
+            return (new_params, new_opt_state, new_c_global, new_c_cohort,
+                    RoundMetrics(mean_loss, n_total))
         return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
 
     return round_fn

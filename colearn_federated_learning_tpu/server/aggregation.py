@@ -36,6 +36,11 @@ def make_server_optimizer(cfg: ServerConfig) -> optax.GradientTransformation:
         return optax.sgd(cfg.server_lr, momentum=cfg.server_momentum)
     if cfg.optimizer == "fedadam":
         return optax.adam(cfg.server_lr, eps=1e-3)
+    if cfg.optimizer == "fedyogi":
+        # Reddi et al. 2021 (Adaptive Federated Optimization) — yogi's
+        # additive second-moment update resists the per-round pseudo-
+        # gradient variance that makes fedadam's v_t collapse early.
+        return optax.yogi(cfg.server_lr, eps=1e-3)
     raise ValueError(f"unknown server optimizer {cfg.optimizer!r}")
 
 

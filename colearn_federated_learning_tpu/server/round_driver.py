@@ -64,6 +64,12 @@ class Experiment:
             ),
         )
         self.server_opt_init, server_update = make_server_update_fn(cfg.server)
+        # SCAFFOLD (cfg.algorithm): per-client control variates live
+        # host-resident as one stacked numpy tree ([N, ...] per leaf);
+        # each round gathers the cohort's rows to device and scatters the
+        # updated rows back (the one algorithm that forces a per-round
+        # host sync — stateful clients are outside the pure round program)
+        self.scaffold = cfg.algorithm == "scaffold"
         # Size-proportional sampling pairs with UNIFORM aggregation
         # weights: example-weighting on top of p∝size sampling would count
         # shard size twice (contribution ∝ size²). Uniform sampling keeps
@@ -101,6 +107,7 @@ class Experiment:
                 server_update, cfg.server.cohort_size,
                 client_vmap_width=cfg.run.client_vmap_width,
                 local_dtype=self._local_dtype(), agg=agg,
+                scaffold=self.scaffold, num_clients=self.fed.num_clients,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -111,6 +118,7 @@ class Experiment:
             self.round_fn = make_sequential_round_fn(
                 self.model, cfg.client, cfg.dp, self.task, server_update,
                 local_dtype=self._local_dtype(), agg=agg,
+                scaffold=self.scaffold, num_clients=self.fed.num_clients,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -198,18 +206,41 @@ class Experiment:
         dummy = jnp.asarray(self.fed.train_x[:1])
         variables = self.model.init(init_rng, dummy, train=False)
         params = variables["params"]
-        return {
+        state = {
             "params": params,
             "server_opt_state": self.server_opt_init(params),
             "round": 0,
             "rng_key": run_rng,
         }
+        if self.scaffold:
+            # c (replicated, on device at _place_state) + all-clients cᵢ
+            # (host numpy; only cohort rows travel to the device per round)
+            state["c_global"] = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            state["c_clients"] = jax.tree.map(
+                lambda p: np.zeros((self.fed.num_clients,) + p.shape, np.float32),
+                params,
+            )
+        return state
 
     def _place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
         """Replicate params/opt state over the mesh (fresh init or restore)."""
         if self._data_sharding is not None:
             state["params"] = self._put_data(state["params"])
             state["server_opt_state"] = self._put_data(state["server_opt_state"])
+            if self.scaffold:
+                state["c_global"] = self._put_data(state["c_global"])
+        if self.scaffold:
+            # restored checkpoints arrive as jax arrays; the scatter path
+            # needs writable host numpy (fresh init already is — don't
+            # double several GB of per-client state for nothing)
+            state["c_clients"] = jax.tree.map(
+                lambda a: a
+                if isinstance(a, np.ndarray) and a.flags.writeable
+                else np.array(a, dtype=np.float32, copy=True),
+                state["c_clients"],
+            )
         return state
 
     def _host_inputs(self, round_idx: int):
@@ -287,6 +318,33 @@ class Experiment:
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
         cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
+        if self.scaffold:
+            c_cohort = jax.tree.map(
+                lambda a: self._put(jnp.asarray(a[cohort]), self._client_sharding),
+                state["c_clients"],
+            )
+            params, opt_state, c_global, new_c_cohort, metrics = self.round_fn(
+                state["params"], state["server_opt_state"],
+                train_x, train_y, idx, mask, n_ex, rng,
+                state["c_global"], c_cohort,
+            )
+            # scatter the cohort's updated cᵢ back into the host store —
+            # the per-round sync point stateful clients require
+            fetched = jax.device_get(new_c_cohort)
+            rows = np.asarray(cohort)
+            jax.tree.map(
+                lambda store, f: store.__setitem__(rows, f),
+                state["c_clients"], fetched,
+            )
+            return {
+                "params": params,
+                "server_opt_state": opt_state,
+                "round": round_idx + 1,
+                "rng_key": state["rng_key"],
+                "c_global": c_global,
+                "c_clients": state["c_clients"],
+                "_metrics": metrics,
+            }
         params, opt_state, metrics = self.round_fn(
             state["params"], state["server_opt_state"],
             train_x, train_y, idx, mask, n_ex, rng,

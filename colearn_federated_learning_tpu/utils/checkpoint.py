@@ -1,9 +1,13 @@
 """Checkpoint / resume (SURVEY.md §2 C15, §5) on orbax.
 
-Persisted state: ``{params, server_opt_state, round, rng_key}``. The
-cohort sampler is stateless (pure function of seed+round), so resume at
-round r replays the exact schedule — determinism test §4.5 covers this
-across a save/restore boundary.
+Persisted state: ``{params, server_opt_state, round, rng_key}`` where
+``server_opt_state`` is the ``{"round": int32, "opt": <optax state>}``
+wrapper (aggregation.py); SCAFFOLD runs additionally persist
+``c_global`` (params-shaped f32 tree) and ``c_clients`` (``[N, ...]``
+stacked f32 tree of every client's control variate). The cohort sampler
+is stateless (pure function of seed+round), so resume at round r
+replays the exact schedule — determinism test §4.5 covers this across a
+save/restore boundary.
 """
 
 from __future__ import annotations

@@ -61,3 +61,24 @@ def test_fedadam_runs_and_moves_params():
     new_params, _ = update(params, init(params), delta)
     moved = trees.tree_sq_norm(trees.tree_sub(new_params, params))
     assert float(moved) > 0
+
+
+def test_fedyogi_runs_and_tracks_delta_direction():
+    # optax.yogi seeds v_0 with a small constant (yogi paper §3), so no
+    # exact-adam first step; pin the semantics instead: with a constant
+    # positive pseudo-gradient every parameter moves toward params+delta,
+    # and repeated updates keep moving (no v_t collapse).
+    params = _tree(0)
+    delta = _tree(1)
+    init, update = make_server_update_fn(ServerConfig(optimizer="fedyogi", server_lr=0.1))
+    s = init(params)
+    p, s = update(params, s, delta)
+    jax.tree.map(
+        lambda p1, p0, d: np.testing.assert_array_equal(
+            np.sign(p1 - p0), np.sign(np.asarray(d))
+        ),
+        p, params, delta,
+    )
+    p2, _ = update(p, s, delta)
+    moved = trees.tree_sq_norm(trees.tree_sub(p2, p))
+    assert float(moved) > 0

@@ -1,0 +1,288 @@
+"""SCAFFOLD (Karimireddy et al. 2020, option II) — the control-variate
+identity, sharded-vs-sequential parity, participation gating, the
+c == mean(cᵢ) invariant end-to-end, and checkpoint/resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.client.trainer import make_loss_fn
+from colearn_federated_learning_tpu.config import (
+    ClientConfig,
+    DPConfig,
+    ServerConfig,
+    get_named_config,
+)
+from colearn_federated_learning_tpu.data.loader import RoundShape, make_round_indices
+from colearn_federated_learning_tpu.models import build_model, init_params
+from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+class _Fed:
+    def __init__(self, client_indices):
+        self.client_indices = client_indices
+
+
+def _setup(cohort=8, n=256, steps=RoundShape(2, 4, 8, 32)):
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+    splits = np.array_split(rng.permutation(n), cohort)
+    fed = _Fed([s[: rng.integers(8, len(s) + 1)] for s in splits])
+    idx, mask, n_ex = make_round_indices(fed, list(range(cohort)), steps, rng)
+    return model, params, x, y, idx, mask, n_ex
+
+
+def _c_state(params, cohort, seed=None):
+    """(c_global, c_cohort) — zeros, or random f32 when seeded."""
+    if seed is None:
+        cg = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        cc = jax.tree.map(
+            lambda p: jnp.zeros((cohort,) + p.shape, jnp.float32), params
+        )
+        return cg, cc
+    rngs = np.random.default_rng(seed)
+    cg = jax.tree.map(
+        lambda p: jnp.asarray(
+            0.01 * rngs.normal(size=p.shape).astype(np.float32)
+        ),
+        params,
+    )
+    cc = jax.tree.map(
+        lambda p: jnp.asarray(
+            0.01 * rngs.normal(size=(cohort,) + p.shape).astype(np.float32)
+        ),
+        params,
+    )
+    return cg, cc
+
+
+def test_one_step_c_update_equals_batch_gradient():
+    """With c = cᵢ = 0 and ONE valid local step, option II gives
+    cᵢ⁺ = (w₀ − w₁)/lr = the batch gradient at w₀ — checked against
+    jax.grad directly."""
+    model, params, x, y, idx, mask, n_ex = _setup(
+        cohort=1, steps=RoundShape(1, 1, 8, 8)
+    )
+    ccfg = ClientConfig(local_epochs=1, batch_size=8, lr=0.05, momentum=0.0)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=1)
+    init, server_update = make_server_update_fn(scfg)
+    seq = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        scaffold=True, num_clients=1,
+    )
+    cg, cc = _c_state(params, 1)
+    _, _, _, new_cc, _ = seq(
+        params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
+        jnp.asarray(n_ex), jax.random.PRNGKey(0), cg, cc,
+    )
+    xb = jnp.take(x, jnp.asarray(idx[0, 0]), axis=0)
+    yb = jnp.take(y, jnp.asarray(idx[0, 0]), axis=0)
+    g = jax.grad(make_loss_fn(model, "classify"))(
+        params, xb, yb, jnp.asarray(mask[0, 0])
+    )
+    jax.tree.map(
+        lambda got, want: np.testing.assert_allclose(
+            np.asarray(got)[0], np.asarray(want), rtol=1e-4, atol=1e-6
+        ),
+        new_cc, g,
+    )
+
+
+@pytest.mark.parametrize("lanes", [8, 4, 1])
+def test_scaffold_sharded_matches_sequential(lanes):
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.0)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(lanes)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False, scaffold=True, num_clients=16,
+    )
+    sequential = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        scaffold=True, num_clients=16,
+    )
+    cg, cc = _c_state(params, 8, seed=5)
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
+            jax.random.PRNGKey(42), cg, cc)
+    p_sh, _, cg_sh, cc_sh, m_sh = sharded(params, init(params), *args)
+    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args)
+    for got, want in ((p_sh, p_sq), (cg_sh, cg_sq), (cc_sh, cc_sq)):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+            got, want,
+        )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+
+
+def test_scaffold_batch_sharded_matches_sequential():
+    """clients×batch 2D mesh: Kᵢ must count steps on the GLOBAL mask
+    (a step whose valid examples all sit on another batch shard is
+    still a real step), so c outputs stay batch-invariant."""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=4)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.0)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=4)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(2, batch_shards=2)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=4, donate=False, scaffold=True, num_clients=8,
+    )
+    sequential = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        scaffold=True, num_clients=8,
+    )
+    cg, cc = _c_state(params, 4, seed=11)
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
+            jax.random.PRNGKey(9), cg, cc)
+    p_sh, _, cg_sh, cc_sh, m_sh = sharded(params, init(params), *args)
+    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args)
+    for got, want in ((p_sh, p_sq), (cg_sh, cg_sq), (cc_sh, cc_sq)):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+            got, want,
+        )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+
+
+def test_scaffold_bf16_params_dc_carry():
+    """Regression: the dc scan-carry must be f32 even when server params
+    are bf16 (the f32 per-block increment would otherwise mismatch the
+    carry type and fail the scan trace)."""
+    import jax.numpy as jnp2
+
+    model = build_model("lenet5", num_classes=10, param_dtype=jnp2.bfloat16)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (64, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+    fed = _Fed([np.arange(0, 32), np.arange(32, 64)])
+    idx, mask, n_ex = make_round_indices(
+        fed, [0, 1], RoundShape(1, 2, 8, 16), rng
+    )
+    ccfg = ClientConfig(local_epochs=1, batch_size=8, lr=0.1, momentum=0.0)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=2)
+    init, server_update = make_server_update_fn(scfg)
+    fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(2),
+        server_update, cohort_size=2, donate=False, scaffold=True,
+        num_clients=2,
+    )
+    cg, cc = _c_state(params, 2)
+    p, _, cg2, cc2, m = fn(
+        params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
+        jnp.asarray(n_ex), jax.random.PRNGKey(0), cg, cc,
+    )
+    assert np.isfinite(float(m.train_loss))
+    for leaf in jax.tree.leaves(cg2):
+        assert leaf.dtype == jnp.float32
+
+
+def test_non_participant_keeps_control_variate():
+    """Dropout-zeroed clients contribute no Δc and keep cᵢ exactly."""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.0)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(4)
+    fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False, scaffold=True, num_clients=8,
+    )
+    cg, cc = _c_state(params, 8, seed=3)
+    n_drop = n_ex.copy()
+    n_drop[5] = 0.0
+    _, _, _, new_cc, _ = fn(
+        params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
+        jnp.asarray(n_drop), jax.random.PRNGKey(1), cg, cc,
+    )
+    jax.tree.map(
+        lambda new, old: np.testing.assert_array_equal(
+            np.asarray(new)[5], np.asarray(old)[5]
+        ),
+        new_cc, cc,
+    )
+
+
+def _scaffold_cfg(tmp_path, rounds=3, num_clients=4, cohort=2):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.algorithm = "scaffold"
+    cfg.client.momentum = 0.0
+    cfg.data.num_clients = num_clients
+    cfg.server.cohort_size = cohort
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    return cfg
+
+
+def test_scaffold_e2e_c_mean_invariant(tmp_path):
+    """c ← c + (1/N)ΣΔcᵢ keeps c == mean(cᵢ) exactly (both start at 0);
+    partial participation (cohort < N) must not break it."""
+    cfg = _scaffold_cfg(tmp_path, rounds=3)
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert exp.scaffold
+    c_mean = jax.tree.map(lambda a: a.mean(0), state["c_clients"])
+    jax.tree.map(
+        lambda cg, cm: np.testing.assert_allclose(
+            np.asarray(cg), np.asarray(cm), rtol=1e-4, atol=1e-6
+        ),
+        state["c_global"], c_mean,
+    )
+    # the control variates are alive (some client trained)
+    total = sum(
+        float(np.abs(np.asarray(l)).sum())
+        for l in jax.tree.leaves(state["c_clients"])
+    )
+    assert total > 0
+    metrics = exp.evaluate(state["params"])
+    assert np.isfinite(metrics["eval_loss"])
+
+
+def test_scaffold_resume_reproduces_straight_run(tmp_path):
+    def run(path, rounds, resume=False):
+        cfg = _scaffold_cfg(path, rounds=rounds)
+        cfg.server.checkpoint_every = 1
+        cfg.run.resume = resume
+        return Experiment(cfg, echo=False).fit()
+
+    straight = run(tmp_path / "straight", 4)
+    run(tmp_path / "resumed", 2)
+    resumed = run(tmp_path / "resumed", 4, resume=True)
+    assert int(resumed["round"]) == 4
+    for key in ("params", "c_global", "c_clients"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            straight[key], resumed[key],
+        )
+
+
+def test_scaffold_config_validation():
+    cfg = _scaffold_cfg("unused")
+    cfg.client.momentum = 0.9
+    with pytest.raises(ValueError, match="momentum"):
+        cfg.validate()
+    cfg = _scaffold_cfg("unused")
+    cfg.dp.enabled = True
+    with pytest.raises(ValueError, match="dp"):
+        cfg.validate()
+    cfg = _scaffold_cfg("unused")
+    cfg.run.local_param_dtype = "bfloat16"
+    with pytest.raises(ValueError, match="f32 local training"):
+        cfg.validate()
