@@ -65,6 +65,14 @@ def build_parser():
     ev = sub.add_parser("evaluate", help="evaluate latest (or --step) checkpoint")
     _add_common(ev)
     ev.add_argument("--step", type=int, default=None, help="checkpoint round to load")
+    ev.add_argument("--personalize", action="store_true",
+                    help="also report per-client fine-tune-then-eval accuracy")
+    ev.add_argument("--personalize-epochs", type=int, default=1,
+                    help="local fine-tune epochs per client")
+    ev.add_argument("--personalize-clients", type=int, default=32,
+                    help="max clients evaluated (sampled deterministically)")
+    ev.add_argument("--holdout-frac", type=float, default=0.2,
+                    help="per-client held-out fraction for the local eval")
 
     sub.add_parser("configs", help="list named configs")
     return p
@@ -125,7 +133,20 @@ def main(argv=None):
         print(json.dumps(final))
         return 0
     if args.cmd == "evaluate":
-        print(json.dumps(exp.evaluate_checkpoint(step=args.step)))
+        kwargs = {}
+        if args.personalize:
+            kwargs = {
+                "personalize": True,
+                "epochs": args.personalize_epochs,
+                "max_clients": args.personalize_clients,
+                "holdout_frac": args.holdout_frac,
+            }
+        try:
+            out = exp.evaluate_checkpoint(step=args.step, **kwargs)
+        except ValueError as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        print(json.dumps(out))
         return 0
     return 1
 

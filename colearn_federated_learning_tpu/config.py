@@ -81,6 +81,14 @@ class ServerConfig:
     optimizer: str = "mean"
     server_lr: float = 1.0
     server_momentum: float = 0.9
+    # Cohort delta aggregation:
+    #   weighted_mean — FedAvg's example-weighted mean (single psum)
+    #   median | trimmed_mean — coordinate-wise Byzantine-robust
+    #   statistics over per-client deltas (unweighted by design; costs
+    #   K× the aggregation memory of the psum path)
+    aggregator: str = "weighted_mean"
+    # fraction trimmed from EACH side per coordinate (trimmed_mean only)
+    trim_ratio: float = 0.1
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -202,6 +210,12 @@ class ExperimentConfig:
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in ("uniform", "weighted"):
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
+        if self.server.aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+            raise ValueError(f"unknown server.aggregator {self.server.aggregator!r}")
+        if not 0.0 <= self.server.trim_ratio < 0.5:
+            raise ValueError(
+                f"server.trim_ratio must be in [0, 0.5), got {self.server.trim_ratio}"
+            )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
         if self.data.placement not in ("hbm", "stream"):

@@ -84,7 +84,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           cohort_size: int, donate: bool = True,
                           client_vmap_width: int = 1, local_dtype=None,
                           agg: str = "examples", scaffold: bool = False,
-                          num_clients: int = 0):
+                          num_clients: int = 0,
+                          aggregator: str = "weighted_mean",
+                          trim_ratio: float = 0.1):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -126,6 +128,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     (momentum breaks the identity — config.validate enforces it);
     non-participating clients (dropout / empty shards) keep cᵢ and
     contribute zero Δc. All c math is f32 regardless of local dtype.
+
+    ``aggregator``: ``"weighted_mean"`` (default — the single-psum
+    FedAvg path) or a Byzantine-robust statistic (``"median"`` /
+    ``"trimmed_mean"``, server/aggregation.py ``robust_reduce``). Robust
+    modes emit the cohort's per-client deltas client-sharded from the
+    lane and reduce them with plain jnp ops OUTSIDE the shard_map but
+    inside the same jit — GSPMD inserts the cross-lane collectives for
+    the coordinate-wise sort, so one XLA program per round still holds.
+    Costs K× the aggregation memory/traffic of the psum path (inherent:
+    order statistics need all K values).
     """
     batch_sharded = has_batch_axis(mesh)
     if batch_sharded and client_cfg.batch_size % mesh.shape[BATCH_AXIS]:
@@ -154,6 +166,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         raise ValueError(f"unknown aggregation mode {agg!r}")
     if scaffold and num_clients <= 0:
         raise ValueError("scaffold requires num_clients (for the c update)")
+    if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+        raise ValueError(f"unknown aggregator {aggregator!r}")
+    robust = aggregator != "weighted_mean"
     use_decay = client_cfg.lr_decay != 1.0
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
@@ -187,19 +202,28 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             # (n>0) under "uniform" — dropout zeroing propagates either way
             b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
             d_acc, w_acc, n_acc, l_acc, dc_acc = acc
-            # Σ over the block of w_i·(Δ_i), fused as one contraction;
-            # delta math in the ACCUMULATOR dtype (f32 server params):
-            # bf16 local weights upcast here, so client-side mixed
-            # precision never degrades the aggregation
-            d_acc = jax.tree.map(
-                lambda a, w, p: a + jnp.einsum(
-                    "c,c...->...",
-                    b_w.astype(a.dtype),
-                    (w.astype(a.dtype) - p[None].astype(a.dtype)),
-                ).astype(a.dtype),
-                d_acc, w_b, params,
-            )
-            new_c_block = None
+            ys = {}
+            if robust:
+                # robust modes need every client's delta individually —
+                # emit the block's deltas (f32) instead of accumulating
+                ys["delta"] = jax.tree.map(
+                    lambda w, p: w.astype(jnp.float32)
+                    - p[None].astype(jnp.float32),
+                    w_b, params,
+                )
+            else:
+                # Σ over the block of w_i·(Δ_i), fused as one contraction;
+                # delta math in the ACCUMULATOR dtype (f32 server params):
+                # bf16 local weights upcast here, so client-side mixed
+                # precision never degrades the aggregation
+                d_acc = jax.tree.map(
+                    lambda a, w, p: a + jnp.einsum(
+                        "c,c...->...",
+                        b_w.astype(a.dtype),
+                        (w.astype(a.dtype) - p[None].astype(a.dtype)),
+                    ).astype(a.dtype),
+                    d_acc, w_b, params,
+                )
             if scaffold:
                 # Kᵢ = # non-padded steps, counted on the GLOBAL mask so
                 # batch shards agree on validity (same rule as the
@@ -219,8 +243,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 dc_acc = jax.tree.map(
                     lambda a, nc, ci: a + (nc - ci).sum(0), dc_acc, new_c_block, b_c
                 )
+                ys["c"] = new_c_block
             return (d_acc, w_acc + b_w.sum(), n_acc + b_n.sum(),
-                    l_acc + (b_w * m_b.loss).sum(), dc_acc), new_c_block
+                    l_acc + (b_w * m_b.loss).sum(), dc_acc), ys
 
         n_blocks = idx.shape[0] // width
         scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if scaffold else ())
@@ -234,28 +259,34 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             if scaffold else jnp.zeros(())
         )
+        # robust modes emit per-client deltas as scan ys instead of the
+        # weighted-sum accumulator — collapse that carry slot to a scalar
+        d0 = jnp.zeros(()) if robust else trees.tree_zeros_like(params)
         acc0 = _pcast_varying(
-            (trees.tree_zeros_like(params),
-             jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), dc0),
+            (d0, jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), dc0),
         )
-        (d_sum, w_sum, n_sum, l_sum, dc_sum), new_c = jax.lax.scan(
+        (d_sum, w_sum, n_sum, l_sum, dc_sum), ys = jax.lax.scan(
             per_block, acc0, blocked
         )
         # The aggregation collective — the reference's NCCL allreduce
         # (BASELINE.json:5) as a single XLA psum over the ICI.
-        d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
         w_sum = jax.lax.psum(w_sum, CLIENT_AXIS)
         n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
         l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
         denom = jnp.maximum(w_sum, 1.0)
-        mean_delta = trees.tree_scale(d_sum, 1.0 / denom)
+        unblock = lambda t: jax.tree.map(  # noqa: E731  [n_blocks,width,...]→[C,...]
+            lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), t
+        )
+        out = {"n": n_sum, "loss": l_sum / denom}
+        if robust:
+            out["deltas"] = unblock(ys["delta"])  # client-sharded stack
+        else:
+            d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
+            out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / denom)
         if scaffold:
-            dc_sum = jax.lax.psum(dc_sum, CLIENT_AXIS)
-            new_c = jax.tree.map(
-                lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), new_c
-            )
-            return mean_delta, n_sum, l_sum / denom, dc_sum, new_c
-        return mean_delta, n_sum, l_sum / denom
+            out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
+            out["new_c"] = unblock(ys["c"])
+        return out
 
     # [K, steps, batch] index/mask tensors additionally shard the batch
     # dim over the batch axis when present; n_ex/keys stay per-client.
@@ -267,12 +298,31 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         in_specs += (P(),)  # lr_scale scalar, replicated
     if scaffold:
         in_specs += (P(), P(CLIENT_AXIS))  # c_global, c_cohort
+    out_specs = {"n": P(), "loss": P()}
+    if robust:
+        out_specs["deltas"] = P(CLIENT_AXIS)
+    else:
+        out_specs["mean_delta"] = P()
+    if scaffold:
+        out_specs["dc_sum"] = P()
+        out_specs["new_c"] = P(CLIENT_AXIS)
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(), P(), P(), P(), P(CLIENT_AXIS)) if scaffold else (P(), P(), P()),
+        out_specs=out_specs,
     )
+
+    def _mean_delta(out, n_ex):
+        if robust:
+            from colearn_federated_learning_tpu.server.aggregation import (
+                robust_reduce,
+            )
+
+            # global [K, ...] stack, client-sharded; the coordinate-wise
+            # sort runs as plain jnp under jit — GSPMD handles the lanes
+            return robust_reduce(out["deltas"], n_ex > 0, aggregator, trim_ratio)
+        return out["mean_delta"]
 
     if scaffold:
 
@@ -283,19 +333,19 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
-            mean_delta, n_total, mean_loss, dc_sum, new_c_cohort = sharded_lane(
+            out = sharded_lane(
                 params, train_x, train_y, idx, mask, n_ex, keys,
                 *extra, c_global, c_cohort,
             )
             new_params, new_opt_state = server_update(
-                params, server_opt_state, mean_delta
+                params, server_opt_state, _mean_delta(out, n_ex)
             )
             # c ← c + (1/N)·Σᵢ∈S Δcᵢ  (paper's |S|/N · mean over S)
             new_c_global = jax.tree.map(
-                lambda c, dc: c + dc / float(num_clients), c_global, dc_sum
+                lambda c, dc: c + dc / float(num_clients), c_global, out["dc_sum"]
             )
-            return (new_params, new_opt_state, new_c_global, new_c_cohort,
-                    RoundMetrics(mean_loss, n_total))
+            return (new_params, new_opt_state, new_c_global, out["new_c"],
+                    RoundMetrics(out["loss"], out["n"]))
 
         return round_fn
 
@@ -307,27 +357,34 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             # round-indexed client LR decay, derived inside the program
             # from the server state's round counter (aggregation.py)
             extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
-        mean_delta, n_total, mean_loss = sharded_lane(
+        out = sharded_lane(
             params, train_x, train_y, idx, mask, n_ex, keys, *extra
         )
-        new_params, new_opt_state = server_update(params, server_opt_state, mean_delta)
-        return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
+        new_params, new_opt_state = server_update(
+            params, server_opt_state, _mean_delta(out, n_ex)
+        )
+        return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
 
     return round_fn
 
 
 def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              local_dtype=None, agg: str = "examples",
-                             scaffold: bool = False, num_clients: int = 0):
+                             scaffold: bool = False, num_clients: int = 0,
+                             aggregator: str = "weighted_mean",
+                             trim_ratio: float = 0.1):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
-    engine is tested against (SURVEY.md §4.3). ``scaffold`` mirrors the
-    sharded engine's control-variate signature exactly."""
+    engine is tested against (SURVEY.md §4.3). ``scaffold`` and
+    ``aggregator`` mirror the sharded engine's signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     if scaffold and num_clients <= 0:
         raise ValueError("scaffold requires num_clients (for the c update)")
+    if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+        raise ValueError(f"unknown aggregator {aggregator!r}")
+    robust = aggregator != "weighted_mean"
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
                                               local_dtype=local_dtype))
     update = jax.jit(server_update)
@@ -384,10 +441,20 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             losses.append(m_i.loss)
         n_total = jnp.asarray(n_ex).sum()
         denom = jnp.maximum(jnp.sum(jnp.stack(weights)), 1.0)
-        acc = trees.tree_zeros_like(params)
-        for d, w in zip(deltas, weights):
-            acc = trees.tree_axpy(w, d, acc)
-        mean_delta = trees.tree_scale(acc, 1.0 / denom)
+        if robust:
+            from colearn_federated_learning_tpu.server.aggregation import (
+                robust_reduce,
+            )
+
+            stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *deltas)
+            mean_delta = robust_reduce(
+                stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio
+            )
+        else:
+            acc = trees.tree_zeros_like(params)
+            for d, w in zip(deltas, weights):
+                acc = trees.tree_axpy(w, d, acc)
+            mean_delta = trees.tree_scale(acc, 1.0 / denom)
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
         new_params, new_opt_state = update(params, server_opt_state, mean_delta)
         if scaffold:

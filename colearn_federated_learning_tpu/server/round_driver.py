@@ -17,8 +17,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from colearn_federated_learning_tpu.client.trainer import make_eval_fn
-from colearn_federated_learning_tpu.config import ExperimentConfig
+from colearn_federated_learning_tpu.client.trainer import (
+    make_eval_fn,
+    make_local_train_fn,
+)
+from colearn_federated_learning_tpu.config import DPConfig, ExperimentConfig
 from colearn_federated_learning_tpu.data import build_federated_data
 from colearn_federated_learning_tpu.data.loader import (
     compute_round_shape,
@@ -108,6 +111,8 @@ class Experiment:
                 client_vmap_width=cfg.run.client_vmap_width,
                 local_dtype=self._local_dtype(), agg=agg,
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
+                aggregator=cfg.server.aggregator,
+                trim_ratio=cfg.server.trim_ratio,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -119,6 +124,8 @@ class Experiment:
                 self.model, cfg.client, cfg.dp, self.task, server_update,
                 local_dtype=self._local_dtype(), agg=agg,
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
+                aggregator=cfg.server.aggregator,
+                trim_ratio=cfg.server.trim_ratio,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -514,12 +521,124 @@ class Experiment:
         loss, acc, n = jax.device_get((loss_sum, correct_sum, n_sum))
         return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
 
-    def evaluate_checkpoint(self, step: Optional[int] = None) -> Dict[str, float]:
+    def evaluate_personalized(self, params, epochs: int = 1,
+                              holdout_frac: float = 0.2,
+                              max_clients: int = 32,
+                              seed: Optional[int] = None) -> Dict[str, float]:
+        """Per-client personalization metric (pFL evaluation protocol):
+        fine-tune the GLOBAL model ``epochs`` epochs on each client's
+        train split, then evaluate on that client's held-out split;
+        ``baseline_*`` is the un-tuned global model on the SAME holdouts,
+        so the personalization gain is read directly off the pair.
+
+        Deterministic in ``seed`` (splits, batch order, sampled client
+        subset). Clients with fewer than 2 examples are skipped. Uses a
+        per-client slab gather (host → device) so it works under both
+        ``data.placement`` modes; cost is one local-training call per
+        evaluated client — cap via ``max_clients``."""
+        if epochs < 1:
+            raise ValueError(f"personalize epochs must be >= 1, got {epochs}")
+        if not 0.0 < holdout_frac < 1.0:
+            raise ValueError(
+                f"holdout_frac must be in (0, 1), got {holdout_frac}"
+            )
+        if max_clients < 1:
+            raise ValueError(f"max_clients must be >= 1, got {max_clients}")
+        seed = self.cfg.run.seed if seed is None else seed
+        rng = np.random.default_rng((seed, 104729))
+        eligible = [
+            cid for cid in range(self.fed.num_clients)
+            if len(self.fed.client_indices[cid]) >= 2
+        ]
+        if len(eligible) > max_clients:
+            eligible = sorted(
+                rng.choice(eligible, size=max_clients, replace=False)
+            )
+        batch = self.cfg.client.batch_size
+        cap = self.shape.cap
+        steps = epochs * self.shape.steps_per_epoch
+        key = (steps, cap)
+        if getattr(self, "_personal_train_key", None) != key:
+            self._personal_train = jax.jit(make_local_train_fn(
+                self.model, self.cfg.client, DPConfig(), self.task
+            ))
+            self._personal_train_key = key
+
+        pers, base = [], []
+        for cid in eligible:
+            ids = rng.permutation(np.asarray(self.fed.client_indices[cid]))
+            n_hold = min(max(1, int(round(holdout_frac * len(ids)))),
+                         len(ids) - 1)
+            hold, train = ids[:n_hold], ids[n_hold:]
+            if len(train) > cap:
+                train = train[:cap]
+            n = len(train)
+            # slab-local finetune grid, same layout as make_round_indices
+            idx = np.zeros((steps * batch,), np.int32)
+            mask = np.zeros((steps * batch,), np.float32)
+            per_epoch = self.shape.steps_per_epoch * batch
+            for e in range(epochs):
+                off = e * per_epoch
+                idx[off : off + n] = rng.permutation(n).astype(np.int32)
+                mask[off : off + n] = 1.0
+            pad = cap - n
+            slab_x = self.fed.train_x[train]
+            slab_y = self.fed.train_y[train]
+            if pad:
+                slab_x = np.concatenate(
+                    [slab_x, np.repeat(slab_x[:1], pad, axis=0)]
+                )
+                slab_y = np.concatenate(
+                    [slab_y, np.repeat(slab_y[:1], pad, axis=0)]
+                )
+            p_i, _ = self._personal_train(
+                params, jnp.asarray(slab_x), jnp.asarray(slab_y),
+                jnp.asarray(idx.reshape(steps, batch)),
+                jnp.asarray(mask.reshape(steps, batch)),
+                jax.random.fold_in(jax.random.PRNGKey(seed), cid),
+            )
+            xb, yb, mb = eval_batches(
+                self.fed.train_x[hold], self.fed.train_y[hold], batch
+            )
+            accs = {}
+            for tag, p in (("personalized", p_i), ("baseline", params)):
+                c_sum = n_sum = 0.0
+                for b in range(xb.shape[0]):
+                    _, c, m = self._eval_fn(
+                        p, jnp.asarray(xb[b]), jnp.asarray(yb[b]),
+                        jnp.asarray(mb[b]),
+                    )
+                    c_sum += float(c)
+                    n_sum += float(m)
+                accs[tag] = c_sum / max(n_sum, 1.0)
+            pers.append(accs["personalized"])
+            base.append(accs["baseline"])
+        if not pers:
+            # nothing eligible (all shards < 2 examples): report the
+            # count honestly instead of NaN means (which break JSON)
+            return {"personalized_clients": 0, "personalize_epochs": epochs}
+        pers_a, base_a = np.asarray(pers), np.asarray(base)
+        return {
+            "personalized_acc_mean": float(pers_a.mean()),
+            "personalized_acc_std": float(pers_a.std()),
+            "baseline_acc_mean": float(base_a.mean()),
+            "baseline_acc_std": float(base_a.std()),
+            "personalized_clients": len(pers),
+            "personalize_epochs": epochs,
+        }
+
+    def evaluate_checkpoint(self, step: Optional[int] = None,
+                            personalize: bool = False,
+                            **personalize_kwargs) -> Dict[str, float]:
         store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
         template = self.init_state()
         state, step = store.restore(step=step, template=template)
         store.close()
         state = self._place_state(state)
         out = self.evaluate(state["params"])
+        if personalize:
+            out.update(
+                self.evaluate_personalized(state["params"], **personalize_kwargs)
+            )
         out["round"] = int(state["round"])
         return out

@@ -1,0 +1,166 @@
+"""Byzantine-robust aggregation (coordinate-wise median / trimmed mean):
+math vs numpy oracles, masked participation, corrupted-client resistance,
+and sharded-vs-sequential parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import (
+    ClientConfig,
+    DPConfig,
+    ServerConfig,
+    get_named_config,
+)
+from colearn_federated_learning_tpu.data.loader import RoundShape, make_round_indices
+from colearn_federated_learning_tpu.models import build_model, init_params
+from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.aggregation import (
+    make_server_update_fn,
+    robust_reduce,
+)
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _deltas(k=9, shape=(3, 4), seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(k,) + shape).astype(np.float32))}
+
+
+def test_median_matches_numpy():
+    d = _deltas(k=9)
+    part = jnp.ones((9,))
+    got = robust_reduce(d, part > 0, "median")
+    np.testing.assert_allclose(
+        got["w"], np.median(np.asarray(d["w"]), axis=0), rtol=1e-6
+    )
+
+
+def test_median_even_count_averages_middle_pair():
+    d = _deltas(k=8)
+    got = robust_reduce(d, jnp.ones((8,)) > 0, "median")
+    np.testing.assert_allclose(
+        got["w"], np.median(np.asarray(d["w"]), axis=0), rtol=1e-6
+    )
+
+
+def test_median_excludes_non_participants_exactly():
+    d = _deltas(k=9)
+    part = np.ones(9, bool)
+    part[[2, 5, 7]] = False
+    got = robust_reduce(d, jnp.asarray(part), "median")
+    want = np.median(np.asarray(d["w"])[part], axis=0)
+    np.testing.assert_allclose(got["w"], want, rtol=1e-6)
+
+
+def test_trimmed_mean_matches_manual():
+    d = _deltas(k=10)
+    got = robust_reduce(d, jnp.ones((10,)) > 0, "trimmed_mean", trim_ratio=0.2)
+    s = np.sort(np.asarray(d["w"]), axis=0)
+    want = s[2:8].mean(0)  # floor(0.2*10)=2 trimmed each side
+    np.testing.assert_allclose(got["w"], want, rtol=1e-6)
+
+
+def test_trim_ratio_zero_is_plain_mean():
+    d = _deltas(k=7)
+    got = robust_reduce(d, jnp.ones((7,)) > 0, "trimmed_mean", trim_ratio=0.0)
+    np.testing.assert_allclose(
+        got["w"], np.asarray(d["w"]).mean(0), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_median_resists_corrupted_client():
+    """One client sending a huge delta must not move the median beyond
+    the honest clients' range (the Byzantine story, Yin et al. 2018)."""
+    d = _deltas(k=9)
+    honest = np.asarray(d["w"])
+    poisoned = honest.copy()
+    poisoned[4] = 1e9
+    got = robust_reduce(
+        {"w": jnp.asarray(poisoned)}, jnp.ones((9,)) > 0, "median"
+    )
+    assert np.all(np.asarray(got["w"]) <= honest.max() + 1e-6)
+    # the plain mean, by contrast, is destroyed
+    assert np.abs(poisoned.mean(0)).max() > 1e7
+
+
+def _setup(cohort=8, n=256):
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+
+    class _Fed:
+        def __init__(self, ci):
+            self.client_indices = ci
+
+    splits = np.array_split(rng.permutation(n), cohort)
+    fed = _Fed([s[: rng.integers(8, len(s) + 1)] for s in splits])
+    shape = RoundShape(local_epochs=2, steps_per_epoch=4, batch_size=8, cap=32)
+    idx, mask, n_ex = make_round_indices(fed, list(range(cohort)), shape, rng)
+    return model, params, x, y, idx, mask, n_ex
+
+
+@pytest.mark.parametrize("aggregator", ["median", "trimmed_mean"])
+def test_robust_sharded_matches_sequential(aggregator):
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    mesh = build_client_mesh(4)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False, aggregator=aggregator, trim_ratio=0.125,
+    )
+    sequential = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        aggregator=aggregator, trim_ratio=0.125,
+    )
+    # drop one client so the masked-participation path is exercised
+    n_drop = n_ex.copy()
+    n_drop[2] = 0.0
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_drop),
+            jax.random.PRNGKey(42))
+    p_sh, _, m_sh = sharded(params, init(params), *args)
+    p_sq, _, m_sq = sequential(params, init(params), *args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6),
+        p_sh, p_sq,
+    )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+
+
+def test_robust_e2e_trains(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.aggregator = "median"
+    cfg.data.num_clients = 4
+    cfg.server.cohort_size = 4
+    cfg.server.num_rounds = 10
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    metrics = exp.evaluate(state["params"])
+    assert np.isfinite(metrics["eval_loss"])
+    # the coordinate median is a weaker (magnitude-discarding) signal than
+    # the mean, so it converges slower — but it must still clearly learn
+    assert metrics["eval_acc"] > 0.5, metrics
+
+
+def test_robust_config_validation():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.aggregator = "krum"
+    with pytest.raises(ValueError, match="aggregator"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.trim_ratio = 0.5
+    with pytest.raises(ValueError, match="trim_ratio"):
+        cfg.validate()
