@@ -89,6 +89,13 @@ class ServerConfig:
     aggregator: str = "weighted_mean"
     # fraction trimmed from EACH side per coordinate (trimmed_mean only)
     trim_ratio: float = 0.1
+    # Client-update (uplink) compression applied to each client's delta
+    # BEFORE aggregation — simulates communication-constrained FL:
+    #   "" (off) | topk (keep top fraction by magnitude per tensor)
+    #   | qsgd (unbiased stochastic quantization, Alistarh et al. 2017)
+    compression: str = ""
+    compression_topk_ratio: float = 0.01
+    compression_qsgd_levels: int = 256
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -96,8 +103,15 @@ class ServerConfig:
     # with-replacement limit).
     sampling: str = "uniform"  # uniform | weighted
     # Simulated client dropout: fraction of the sampled cohort whose
-    # update is zeroed inside the round function (straggler model).
+    # update is zeroed inside the round function (total failure).
     dropout_rate: float = 0.0
+    # Simulated stragglers (partial work, FedProx's motivating case):
+    # each round, straggler_rate of the cohort completes only
+    # straggler_work of its local steps (mask-truncated; the FedAvg
+    # weight shrinks to the work actually done). Unlike dropout_rate,
+    # stragglers' partial updates still aggregate.
+    straggler_rate: float = 0.0
+    straggler_work: float = 0.5
 
 
 @dataclass
@@ -206,6 +220,23 @@ class ExperimentConfig:
                     "scaffold requires f32 local training "
                     "(run.local_param_dtype='' or 'float32')"
                 )
+            if self.server.aggregator != "weighted_mean":
+                # the c update (c += Σδc/N) has no robust equivalent: a
+                # poisoned client clipped out of the PARAM update would
+                # still poison c_global, which feeds every later round's
+                # gradients — the robust aggregator would be a bypassable
+                # promise. Forbid rather than mislead.
+                raise ValueError(
+                    "scaffold is incompatible with robust server.aggregator "
+                    "(the control-variate update is a plain mean)"
+                )
+            if self.server.compression:
+                # compressed deltas would move params while cᵢ tracks the
+                # UNcompressed trajectory (w₀−w_K)/(K·lr), permanently
+                # biasing the corrections
+                raise ValueError(
+                    "scaffold is incompatible with server.compression"
+                )
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in ("uniform", "weighted"):
@@ -215,6 +246,42 @@ class ExperimentConfig:
         if not 0.0 <= self.server.trim_ratio < 0.5:
             raise ValueError(
                 f"server.trim_ratio must be in [0, 0.5), got {self.server.trim_ratio}"
+            )
+        if self.server.compression not in ("", "topk", "qsgd"):
+            raise ValueError(
+                f"unknown server.compression {self.server.compression!r}"
+            )
+        if not 0.0 < self.server.compression_topk_ratio <= 1.0:
+            raise ValueError(
+                f"server.compression_topk_ratio must be in (0, 1], "
+                f"got {self.server.compression_topk_ratio}"
+            )
+        if self.server.compression_qsgd_levels < 1:
+            raise ValueError(
+                f"server.compression_qsgd_levels must be >= 1, "
+                f"got {self.server.compression_qsgd_levels}"
+            )
+        if (self.server.compression == "topk"
+                and self.server.aggregator != "weighted_mean"):
+            # top-k zeroes ~(1-ratio) of each client's coordinates; any
+            # coordinate kept by fewer than half the cohort then has a
+            # majority of exact zeros in the sorted column, so the
+            # coordinate-wise median (and most of the trim window) is 0 —
+            # training silently stalls. qsgd (dense, unbiased) composes
+            # fine with robust aggregation.
+            raise ValueError(
+                "server.compression='topk' (sparse) breaks robust "
+                "order-statistic aggregators; use qsgd or weighted_mean"
+            )
+        if not 0.0 <= self.server.straggler_rate <= 1.0:
+            raise ValueError(
+                f"server.straggler_rate must be in [0, 1], "
+                f"got {self.server.straggler_rate}"
+            )
+        if not 0.0 < self.server.straggler_work <= 1.0:
+            raise ValueError(
+                f"server.straggler_work must be in (0, 1], "
+                f"got {self.server.straggler_work}"
             )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")

@@ -12,6 +12,10 @@ exact; they differ in memory/communication shape:
 - ``ring``      — sequence-parallel over the "seq" mesh axis via ppermute
                   (ops/ring_attention.py); only valid inside
                   parallel/sequence.py's shard_map wrapper
+- ``ulysses``   — sequence-parallel via two all_to_alls (heads sharded
+                  during attention, DeepSpeed-Ulysses recipe); same
+                  shard_map requirement as ``ring``; needs heads
+                  divisible by the seq-axis size
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from colearn_federated_learning_tpu.ops.attention import (
     full_attention,
 )
 
-_ALL = ("full", "blockwise", "pallas", "ring")
+_ALL = ("full", "blockwise", "pallas", "ring", "ulysses")
 
 
 def resolve_attention(name: str, *, causal: bool, block_size: int = 128,
@@ -48,6 +52,12 @@ def resolve_attention(name: str, *, causal: bool, block_size: int = 128,
 
         return partial(flash_attention, causal=causal,
                        block_q=block_size, block_kv=block_size)
+    if name == "ulysses":
+        from colearn_federated_learning_tpu.ops.ring_attention import (
+            ulysses_attention,
+        )
+
+        return partial(ulysses_attention, axis_name="seq", causal=causal)
     from colearn_federated_learning_tpu.ops.ring_attention import ring_attention
 
     return partial(ring_attention, axis_name="seq", causal=causal)

@@ -103,6 +103,48 @@ def ring_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
     return _merge_heads(out.astype(q.dtype))
 
 
+def ulysses_attention(q, k, v, heads: int, axis_name: str, causal: bool = True):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses recipe, Jacobs
+    et al. 2023; independent implementation on ``shard_map``): one
+    ``all_to_all`` re-shards q/k/v from sequence-sharded ``[B, T/n, D]``
+    to head-sharded ``[B, H/n, T, hd]``, each device computes FULL-
+    sequence attention for its H/n heads, and a second ``all_to_all``
+    swaps back. Exact. Communication is two all-to-alls riding the ICI
+    instead of the ring's n−1 ppermute hops — the better trade when T×T
+    scores fit per device and latency (not memory) binds; the ring stays
+    the O(T/n)-memory option for extreme T. Requires ``heads % n == 0``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if heads % n:
+        raise ValueError(f"ulysses needs heads ({heads}) divisible by "
+                         f"{n} seq lanes")
+    qh = _split_heads(q, heads)  # [B, H, T/n, hd]
+    kh = _split_heads(k, heads)
+    vh = _split_heads(v, heads)
+
+    def to_heads(x):  # heads → sharded, sequence → gathered
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qf, kf, vf = to_heads(qh), to_heads(kh), to_heads(vh)
+    b, hn, t, hd = qf.shape
+    # f32 score/softmax math — same backend NaN workaround as the ring
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", qf.astype(jnp.float32) * hd**-0.5,
+        kf.astype(jnp.float32),
+    )
+    if causal:
+        pos = jnp.arange(t)
+        keep = pos[:, None] >= pos[None, :]
+        s = jnp.where(keep[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    of = jnp.einsum("bhqk,bhkd->bhqd", p, vf.astype(jnp.float32))
+    out = jax.lax.all_to_all(  # sequence → sharded, heads → gathered
+        of.astype(q.dtype), axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    return _merge_heads(out)
+
+
 def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = True):
     """Single-device blockwise (flash-style) attention: same online-softmax
     recurrence as the ring, scanning k/v blocks from HBM instead of the

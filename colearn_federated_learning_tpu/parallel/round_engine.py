@@ -86,7 +86,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           agg: str = "examples", scaffold: bool = False,
                           num_clients: int = 0,
                           aggregator: str = "weighted_mean",
-                          trim_ratio: float = 0.1):
+                          trim_ratio: float = 0.1,
+                          compression: str = "", topk_ratio: float = 0.01,
+                          qsgd_levels: int = 256):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -170,6 +172,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
     use_decay = client_cfg.lr_decay != 1.0
+    from colearn_federated_learning_tpu.ops.compression import make_compressor
+
+    compress = make_compressor(compression, topk_ratio, qsgd_levels)
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
@@ -203,26 +208,27 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
             d_acc, w_acc, n_acc, l_acc, dc_acc = acc
             ys = {}
+            # per-client deltas in f32 (bf16 local weights upcast here, so
+            # client-side mixed precision never degrades the aggregation);
+            # the uplink-compression operator applies per client BEFORE any
+            # aggregation — exactly where a real client would compress
+            delta_b = jax.tree.map(
+                lambda w, p: w.astype(jnp.float32) - p[None].astype(jnp.float32),
+                w_b, params,
+            )
+            if compress is not None:
+                delta_b = compress(delta_b, b_keys)
             if robust:
                 # robust modes need every client's delta individually —
-                # emit the block's deltas (f32) instead of accumulating
-                ys["delta"] = jax.tree.map(
-                    lambda w, p: w.astype(jnp.float32)
-                    - p[None].astype(jnp.float32),
-                    w_b, params,
-                )
+                # emit the block's deltas instead of accumulating
+                ys["delta"] = delta_b
             else:
-                # Σ over the block of w_i·(Δ_i), fused as one contraction;
-                # delta math in the ACCUMULATOR dtype (f32 server params):
-                # bf16 local weights upcast here, so client-side mixed
-                # precision never degrades the aggregation
+                # Σ over the block of w_i·(Δ_i), fused as one contraction
                 d_acc = jax.tree.map(
-                    lambda a, w, p: a + jnp.einsum(
-                        "c,c...->...",
-                        b_w.astype(a.dtype),
-                        (w.astype(a.dtype) - p[None].astype(a.dtype)),
+                    lambda a, dd: a + jnp.einsum(
+                        "c,c...->...", b_w.astype(jnp.float32), dd
                     ).astype(a.dtype),
-                    d_acc, w_b, params,
+                    d_acc, delta_b,
                 )
             if scaffold:
                 # Kᵢ = # non-padded steps, counted on the GLOBAL mask so
@@ -372,7 +378,9 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              local_dtype=None, agg: str = "examples",
                              scaffold: bool = False, num_clients: int = 0,
                              aggregator: str = "weighted_mean",
-                             trim_ratio: float = 0.1):
+                             trim_ratio: float = 0.1,
+                             compression: str = "", topk_ratio: float = 0.01,
+                             qsgd_levels: int = 256):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -385,6 +393,9 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
+    from colearn_federated_learning_tpu.ops.compression import make_compressor
+
+    compress = make_compressor(compression, topk_ratio, qsgd_levels)
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
                                               local_dtype=local_dtype))
     update = jax.jit(server_update)
@@ -435,7 +446,16 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             else:
                 w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
                                        keys[c], *extra)
-            deltas.append(trees.tree_sub(w_i, params))
+            delta_i = jax.tree.map(
+                lambda w, p: w.astype(jnp.float32) - p.astype(jnp.float32),
+                w_i, params,
+            )
+            if compress is not None:
+                block = compress(
+                    jax.tree.map(lambda a: a[None], delta_i), keys[c][None]
+                )
+                delta_i = jax.tree.map(lambda a: a[0], block)
+            deltas.append(delta_i)
             n_c = jnp.asarray(n_ex[c])
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
             losses.append(m_i.loss)
@@ -451,10 +471,17 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio
             )
         else:
-            acc = trees.tree_zeros_like(params)
+            # deltas accumulate in f32; the final cast mirrors the sharded
+            # engine's accumulator dtype (= server params dtype)
+            acc = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
             for d, w in zip(deltas, weights):
                 acc = trees.tree_axpy(w, d, acc)
-            mean_delta = trees.tree_scale(acc, 1.0 / denom)
+            mean_delta = jax.tree.map(
+                lambda d, p: d.astype(p.dtype),
+                trees.tree_scale(acc, 1.0 / denom), params,
+            )
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
         new_params, new_opt_state = update(params, server_opt_state, mean_delta)
         if scaffold:

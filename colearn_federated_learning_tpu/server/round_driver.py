@@ -113,6 +113,9 @@ class Experiment:
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
                 aggregator=cfg.server.aggregator,
                 trim_ratio=cfg.server.trim_ratio,
+                compression=cfg.server.compression,
+                topk_ratio=cfg.server.compression_topk_ratio,
+                qsgd_levels=cfg.server.compression_qsgd_levels,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -126,6 +129,9 @@ class Experiment:
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
                 aggregator=cfg.server.aggregator,
                 trim_ratio=cfg.server.trim_ratio,
+                compression=cfg.server.compression,
+                topk_ratio=cfg.server.compression_topk_ratio,
+                qsgd_levels=cfg.server.compression_qsgd_levels,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -265,6 +271,21 @@ class Experiment:
             idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+        if self.cfg.server.straggler_rate > 0:
+            # simulated stragglers (SURVEY.md §5, FedProx's motivating
+            # scenario): a fraction of the cohort completes only
+            # straggler_work of its local steps — their mask tail is
+            # truncated, so the engine's padded-step machinery makes the
+            # unfinished steps exact no-ops and the FedAvg weight (and
+            # SCAFFOLD's Kᵢ) shrinks to the work actually done
+            strag = host_rng.random(len(cohort)) < self.cfg.server.straggler_rate
+            if strag.any():
+                done = max(1, int(round(
+                    self.cfg.server.straggler_work * self.shape.steps
+                )))
+                mask = mask.copy()
+                mask[strag, done:, :] = 0.0
+                n_ex = mask.sum((1, 2))
         if self.cfg.server.dropout_rate > 0:
             # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
             participate = (
@@ -524,7 +545,8 @@ class Experiment:
     def evaluate_personalized(self, params, epochs: int = 1,
                               holdout_frac: float = 0.2,
                               max_clients: int = 32,
-                              seed: Optional[int] = None) -> Dict[str, float]:
+                              seed: Optional[int] = None,
+                              round_idx: int = 0) -> Dict[str, float]:
         """Per-client personalization metric (pFL evaluation protocol):
         fine-tune the GLOBAL model ``epochs`` epochs on each client's
         train split, then evaluate on that client's held-out split;
@@ -535,7 +557,11 @@ class Experiment:
         subset). Clients with fewer than 2 examples are skipped. Uses a
         per-client slab gather (host → device) so it works under both
         ``data.placement`` modes; cost is one local-training call per
-        evaluated client — cap via ``max_clients``."""
+        evaluated client — cap via ``max_clients``.
+
+        ``round_idx``: the round the evaluated params came from — the
+        fine-tune runs at the same decayed lr (``lr·decay^round``) the
+        run's clients would use, not the hot initial lr."""
         if epochs < 1:
             raise ValueError(f"personalize epochs must be >= 1, got {epochs}")
         if not 0.0 < holdout_frac < 1.0:
@@ -557,12 +583,14 @@ class Experiment:
         batch = self.cfg.client.batch_size
         cap = self.shape.cap
         steps = epochs * self.shape.steps_per_epoch
-        key = (steps, cap)
-        if getattr(self, "_personal_train_key", None) != key:
+        if getattr(self, "_personal_train", None) is None:
+            # built once — jax.jit retraces per input shape on its own;
+            # local_dtype matches the run so the personalization metric
+            # is measured under the precision clients actually train with
             self._personal_train = jax.jit(make_local_train_fn(
-                self.model, self.cfg.client, DPConfig(), self.task
+                self.model, self.cfg.client, DPConfig(), self.task,
+                local_dtype=self._local_dtype(),
             ))
-            self._personal_train_key = key
 
         pers, base = [], []
         for cid in eligible:
@@ -591,11 +619,15 @@ class Experiment:
                 slab_y = np.concatenate(
                     [slab_y, np.repeat(slab_y[:1], pad, axis=0)]
                 )
+            extra = ()
+            if self.cfg.client.lr_decay != 1.0:
+                extra = (jnp.float32(self.cfg.client.lr_decay ** round_idx),)
             p_i, _ = self._personal_train(
                 params, jnp.asarray(slab_x), jnp.asarray(slab_y),
                 jnp.asarray(idx.reshape(steps, batch)),
                 jnp.asarray(mask.reshape(steps, batch)),
                 jax.random.fold_in(jax.random.PRNGKey(seed), cid),
+                *extra,
             )
             xb, yb, mb = eval_batches(
                 self.fed.train_x[hold], self.fed.train_y[hold], batch
@@ -638,7 +670,10 @@ class Experiment:
         out = self.evaluate(state["params"])
         if personalize:
             out.update(
-                self.evaluate_personalized(state["params"], **personalize_kwargs)
+                self.evaluate_personalized(
+                    state["params"], round_idx=int(state["round"]),
+                    **personalize_kwargs,
+                )
             )
         out["round"] = int(state["round"])
         return out

@@ -1,0 +1,160 @@
+"""Client-update compression: top-k semantics, QSGD unbiasedness,
+engine parity, width-invariance, and the e2e config surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import (
+    ClientConfig,
+    DPConfig,
+    ServerConfig,
+    get_named_config,
+)
+from colearn_federated_learning_tpu.data.loader import RoundShape, make_round_indices
+from colearn_federated_learning_tpu.models import build_model, init_params
+from colearn_federated_learning_tpu.ops.compression import make_compressor
+from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def test_topk_keeps_largest_magnitudes():
+    d = {"w": jnp.asarray([[0.1, -5.0, 0.2, 3.0, -0.05, 0.4]], jnp.float32)}
+    keys = jax.random.split(jax.random.PRNGKey(0), 1)
+    out = make_compressor("topk", topk_ratio=1 / 3)(d, keys)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), [[0.0, -5.0, 0.0, 3.0, 0.0, 0.0]]
+    )
+
+
+def test_topk_ratio_one_is_identity():
+    rng = np.random.default_rng(0)
+    d = {"w": jnp.asarray(rng.normal(size=(3, 17)).astype(np.float32))}
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    out = make_compressor("topk", topk_ratio=1.0)(d, keys)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(d["w"]))
+
+
+def test_qsgd_unbiased():
+    """E[qsgd(x)] = x — the Alistarh et al. 2017 property."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 64)).astype(np.float32)
+    comp = make_compressor("qsgd", qsgd_levels=4)  # coarse → visible noise
+    draws = []
+    for i in range(2000):
+        keys = jax.random.split(jax.random.PRNGKey(i), 1)
+        draws.append(np.asarray(comp({"w": jnp.asarray(x)}, keys)["w"]))
+    mean = np.stack(draws).mean(0)
+    # per-coordinate dither std ≈ ‖x‖/s; the empirical mean over 2000
+    # draws must sit well inside 5 standard errors
+    norm = np.linalg.norm(x)
+    tol = 5 * (norm / 4) / np.sqrt(2000)
+    np.testing.assert_allclose(mean, x, atol=tol)
+
+
+def test_qsgd_preserves_sign_and_zero():
+    x = jnp.asarray([[1.5, -2.0, 0.0, 0.25]], jnp.float32)
+    comp = make_compressor("qsgd", qsgd_levels=8)
+    out = np.asarray(comp({"w": x}, jax.random.split(jax.random.PRNGKey(3), 1))["w"])
+    assert out[0, 2] == 0.0
+    assert out[0, 0] >= 0.0 and out[0, 1] <= 0.0
+
+
+def _setup(cohort=8, n=256):
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+
+    class _Fed:
+        def __init__(self, ci):
+            self.client_indices = ci
+
+    splits = np.array_split(rng.permutation(n), cohort)
+    fed = _Fed([s[: rng.integers(8, len(s) + 1)] for s in splits])
+    shape = RoundShape(local_epochs=2, steps_per_epoch=4, batch_size=8, cap=32)
+    idx, mask, n_ex = make_round_indices(fed, list(range(cohort)), shape, rng)
+    return model, params, x, y, idx, mask, n_ex
+
+
+@pytest.mark.parametrize("kind", ["topk", "qsgd"])
+def test_compressed_sharded_matches_sequential(kind):
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    kw = dict(compression=kind, topk_ratio=0.25, qsgd_levels=16)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(4),
+        server_update, cohort_size=8, donate=False, client_vmap_width=2, **kw,
+    )
+    sequential = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update, **kw,
+    )
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
+            jax.random.PRNGKey(42))
+    p_sh, _, m_sh = sharded(params, init(params), *args)
+    p_sq, _, m_sq = sequential(params, init(params), *args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6),
+        p_sh, p_sq,
+    )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+
+
+def test_compression_composes_with_robust_aggregation():
+    """qsgd-compressed (dense) deltas can still be median-aggregated —
+    the block emits compressed deltas, robust stats consume them. (The
+    sparse topk × robust pairing is rejected at config level: a majority
+    of exact zeros per coordinate would zero the median.)"""
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(4),
+        server_update, cohort_size=8, donate=False,
+        aggregator="median", compression="qsgd", qsgd_levels=16,
+    )
+    p, _, m = fn(params, init(params), x, y, jnp.asarray(idx),
+                 jnp.asarray(mask), jnp.asarray(n_ex), jax.random.PRNGKey(0))
+    assert np.isfinite(float(m.train_loss))
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(p))
+
+
+def test_compression_e2e_trains(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.compression = "topk"
+    cfg.server.compression_topk_ratio = 0.25
+    cfg.server.num_rounds = 8
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    metrics = exp.evaluate(state["params"])
+    assert metrics["eval_acc"] > 0.5, metrics
+
+
+def test_compression_config_validation():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.compression = "gzip"
+    with pytest.raises(ValueError, match="compression"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.compression_topk_ratio = 0.0
+    with pytest.raises(ValueError, match="topk_ratio"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.compression = "topk"
+    cfg.server.aggregator = "median"
+    with pytest.raises(ValueError, match="sparse"):
+        cfg.validate()

@@ -286,3 +286,11 @@ def test_scaffold_config_validation():
     cfg.run.local_param_dtype = "bfloat16"
     with pytest.raises(ValueError, match="f32 local training"):
         cfg.validate()
+    cfg = _scaffold_cfg("unused")
+    cfg.server.aggregator = "median"
+    with pytest.raises(ValueError, match="robust"):
+        cfg.validate()
+    cfg = _scaffold_cfg("unused")
+    cfg.server.compression = "qsgd"
+    with pytest.raises(ValueError, match="compression"):
+        cfg.validate()

@@ -14,6 +14,7 @@ from colearn_federated_learning_tpu.ops.attention import causal_attention, full_
 from colearn_federated_learning_tpu.ops.ring_attention import (
     blockwise_attention,
     ring_attention,
+    ulysses_attention,
 )
 from colearn_federated_learning_tpu.parallel.sequence import (
     build_seq_mesh,
@@ -58,19 +59,82 @@ def test_ring_matches_full_on_mesh(causal, lanes):
     np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref), atol=2e-5)
 
 
-def test_seq_parallel_lm_forward_matches_plain():
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("lanes", [2, 4])
+def test_ulysses_matches_full_on_mesh(causal, lanes):
+    """The all-to-all (Ulysses) protocol computes exact attention when
+    heads divide over the lanes."""
+    q, k, v = _qkv(t=48)
+    mesh = build_seq_mesh(lanes)
+    uly = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, heads=4,
+                                              axis_name="seq", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "seq", None),) * 3,
+            out_specs=P(None, "seq", None),
+        )
+    )
+    ref = (causal_attention if causal else full_attention)(q, k, v, heads=4)
+    np.testing.assert_allclose(np.asarray(uly(q, k, v)), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = _qkv(t=48)
+    mesh = build_seq_mesh(8)  # 4 heads over 8 lanes → error
+    uly = jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, heads=4,
+                                          axis_name="seq", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq", None),) * 3,
+        out_specs=P(None, "seq", None),
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(uly)(q, k, v)
+
+
+def test_ulysses_matches_ring_gradients():
+    """Both sequence-parallel protocols must backprop identically (the
+    all_to_all and ppermute transpose rules both exercise the ICI)."""
+    q, k, v = _qkv(t=32)
+    mesh = build_seq_mesh(4)
+
+    def make_loss(attn):
+        def inner(q, k, v):
+            return attn(q, k, v)
+
+        sharded = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, "seq", None),) * 3,
+            out_specs=P(None, "seq", None),
+        )
+        return jax.jit(jax.grad(lambda q, k, v: (sharded(q, k, v) ** 2).sum()))
+
+    g_ring = make_loss(
+        lambda q, k, v: ring_attention(q, k, v, 4, "seq", causal=True)
+    )(q, k, v)
+    g_uly = make_loss(
+        lambda q, k, v: ulysses_attention(q, k, v, 4, "seq", causal=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_uly), np.asarray(g_ring), atol=3e-5)
+
+
+@pytest.mark.parametrize("backend", ["ring", "ulysses"])
+def test_seq_parallel_lm_forward_matches_plain(backend):
     from colearn_federated_learning_tpu.models import build_model
 
     kw = dict(vocab_size=30, seq_len=64)
     plain = build_model("bert_tiny", 0, **kw)
-    ring = build_model("bert_tiny", 0, attention="ring", **kw)
+    sharded_model = build_model("bert_tiny", 0, attention=backend, **kw)
     tokens = jnp.asarray(
         np.random.default_rng(3).integers(0, 30, (2, 64)).astype(np.int32)
     )
     params = plain.init(jax.random.PRNGKey(0), tokens[:1], train=False)["params"]
     ref = plain.apply({"params": params}, tokens, train=False)
-    mesh = build_seq_mesh(4)
-    fwd = make_seq_parallel_lm_forward(ring, mesh)
+    # bert_tiny has 2 heads — ulysses shards heads, so its lane count
+    # must divide 2; the ring has no such constraint
+    mesh = build_seq_mesh(2 if backend == "ulysses" else 4)
+    fwd = make_seq_parallel_lm_forward(sharded_model, mesh)
     got = fwd(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
 

@@ -1,0 +1,65 @@
+"""Straggler (partial-work) simulation: work=1 is a no-op, partial work
+shrinks the processed-example weight, and training stays finite."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _cfg(tmp_path, rate=0.0, work=0.5, rounds=3):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 4
+    cfg.server.cohort_size = 4
+    cfg.server.straggler_rate = rate
+    cfg.server.straggler_work = work
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    return cfg
+
+
+def test_work_one_is_noop(tmp_path):
+    s_off = Experiment(_cfg(tmp_path / "off"), echo=False).fit()
+    s_on = Experiment(
+        _cfg(tmp_path / "on", rate=1.0, work=1.0), echo=False
+    ).fit()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s_off["params"], s_on["params"],
+    )
+
+
+def test_partial_work_halves_examples(tmp_path):
+    cfg = _cfg(tmp_path, rate=1.0, work=0.5, rounds=1)
+    exp = Experiment(cfg, echo=False)
+    _, _, mask, n_ex, *_ = exp._round_inputs(0)
+    full = 256  # 4 clients × 64 examples, 1 epoch
+    got = float(np.asarray(jax.device_get(n_ex)).sum())
+    # every client truncated to half its steps → about half the examples
+    assert got <= 0.75 * full, got
+    assert got > 0
+
+
+def test_straggler_training_stays_finite(tmp_path):
+    cfg = _cfg(tmp_path, rate=0.5, work=0.25, rounds=4)
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    metrics = exp.evaluate(state["params"])
+    assert np.isfinite(metrics["eval_loss"])
+
+
+def test_straggler_config_validation():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.straggler_rate = 1.5
+    with pytest.raises(ValueError, match="straggler_rate"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.straggler_work = 0.0
+    with pytest.raises(ValueError, match="straggler_work"):
+        cfg.validate()
