@@ -96,6 +96,10 @@ class ServerConfig:
     compression: str = ""
     compression_topk_ratio: float = 0.01
     compression_qsgd_levels: int = 256
+    # Clip each client's delta to this L2 norm (whole-tree) before
+    # aggregation — the standard heterogeneity stabilizer (and DP-SGD's
+    # clipping step without the noise). 0 = off.
+    clip_delta_norm: float = 0.0
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -139,6 +143,12 @@ class RunConfig:
     # width × batch_size keeps the MXU fed for small models); 1 = pure
     # sequential scan (min memory), 0 = whole lane in one vmap
     client_vmap_width: int = 1
+    # Failure recovery (SURVEY.md §5): on an unexpected error inside the
+    # round loop, reload the latest checkpoint and continue, up to this
+    # many times per fit() call. 0 = fail fast. Requires out_dir +
+    # checkpoint_every for mid-run restarts (otherwise the retry starts
+    # from round 0). KeyboardInterrupt is never retried.
+    max_retries: int = 0
     # Host-side round-input construction (idx/mask/n_ex tensors):
     #   auto   — the C++ threaded pipeline (native/) when the toolchain
     #            builds it, else the NumPy path; prefetches round r+1
@@ -237,6 +247,12 @@ class ExperimentConfig:
                 raise ValueError(
                     "scaffold is incompatible with server.compression"
                 )
+            if self.server.clip_delta_norm > 0.0:
+                # same trajectory-mismatch failure as compression: params
+                # move by the CLIPPED delta while cᵢ tracks the raw one
+                raise ValueError(
+                    "scaffold is incompatible with server.clip_delta_norm"
+                )
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in ("uniform", "weighted"):
@@ -272,6 +288,11 @@ class ExperimentConfig:
             raise ValueError(
                 "server.compression='topk' (sparse) breaks robust "
                 "order-statistic aggregators; use qsgd or weighted_mean"
+            )
+        if self.server.clip_delta_norm < 0.0:
+            raise ValueError(
+                f"server.clip_delta_norm must be >= 0, "
+                f"got {self.server.clip_delta_norm}"
             )
         if not 0.0 <= self.server.straggler_rate <= 1.0:
             raise ValueError(

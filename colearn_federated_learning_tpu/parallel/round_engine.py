@@ -56,6 +56,22 @@ def _decay_scale(decay: float, server_opt_state):
     return jnp.power(jnp.float32(decay), r)
 
 
+def _clip_block(delta_b, clip: float):
+    """Clip each client's whole-tree delta to L2 norm ≤ clip.
+
+    ``delta_b`` leaves are ``[width, ...]``; the norm is per CLIENT over
+    all leaves jointly (the DP-SGD clipping geometry), shared by both
+    engines. Applied BEFORE compression — a real client clips as part of
+    its update rule, then compresses the wire format."""
+    sq = sum(
+        (d.reshape(d.shape[0], -1) ** 2).sum(-1) for d in jax.tree.leaves(delta_b)
+    )
+    scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-30))  # [width]
+    return jax.tree.map(
+        lambda d: d * scale.reshape((d.shape[0],) + (1,) * (d.ndim - 1)), delta_b
+    )
+
+
 def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
     """SCAFFOLD option-II control-variate update over a client block.
 
@@ -88,7 +104,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           aggregator: str = "weighted_mean",
                           trim_ratio: float = 0.1,
                           compression: str = "", topk_ratio: float = 0.01,
-                          qsgd_levels: int = 256):
+                          qsgd_levels: int = 256,
+                          clip_delta_norm: float = 0.0):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -216,6 +233,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 lambda w, p: w.astype(jnp.float32) - p[None].astype(jnp.float32),
                 w_b, params,
             )
+            if clip_delta_norm > 0.0:
+                delta_b = _clip_block(delta_b, clip_delta_norm)
             if compress is not None:
                 delta_b = compress(delta_b, b_keys)
             if robust:
@@ -380,7 +399,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              aggregator: str = "weighted_mean",
                              trim_ratio: float = 0.1,
                              compression: str = "", topk_ratio: float = 0.01,
-                             qsgd_levels: int = 256):
+                             qsgd_levels: int = 256,
+                             clip_delta_norm: float = 0.0):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -450,10 +470,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 lambda w, p: w.astype(jnp.float32) - p.astype(jnp.float32),
                 w_i, params,
             )
-            if compress is not None:
-                block = compress(
-                    jax.tree.map(lambda a: a[None], delta_i), keys[c][None]
-                )
+            if clip_delta_norm > 0.0 or compress is not None:
+                # one width-1 block through the SAME operators as the
+                # sharded lane (clip first, then compress the wire format)
+                block = jax.tree.map(lambda a: a[None], delta_i)
+                if clip_delta_norm > 0.0:
+                    block = _clip_block(block, clip_delta_norm)
+                if compress is not None:
+                    block = compress(block, keys[c][None])
                 delta_i = jax.tree.map(lambda a: a[0], block)
             deltas.append(delta_i)
             n_c = jnp.asarray(n_ex[c])

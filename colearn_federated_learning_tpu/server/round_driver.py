@@ -116,6 +116,7 @@ class Experiment:
                 compression=cfg.server.compression,
                 topk_ratio=cfg.server.compression_topk_ratio,
                 qsgd_levels=cfg.server.compression_qsgd_levels,
+                clip_delta_norm=cfg.server.clip_delta_norm,
             )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -132,6 +133,7 @@ class Experiment:
                 compression=cfg.server.compression,
                 topk_ratio=cfg.server.compression_topk_ratio,
                 qsgd_levels=cfg.server.compression_qsgd_levels,
+                clip_delta_norm=cfg.server.clip_delta_norm,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -398,9 +400,62 @@ class Experiment:
         if ex is not None:
             ex.shutdown(wait=True, cancel_futures=True)
 
+    def _ckpt_store(self) -> Optional[CheckpointStore]:
+        if not self.cfg.run.out_dir:
+            return None
+        return CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        caller_state = state is not None
+        # Checkpoint provenance baseline: only checkpoints written BY THIS
+        # fit() call may be restored on retry — restoring a stale
+        # checkpoint left in the same out_dir by an earlier run would
+        # silently return the old run's params as "recovered".
+        baseline_step = None
+        if self.cfg.run.max_retries > 0:
+            store = self._ckpt_store()
+            if store is not None:
+                baseline_step = store.latest_step()
+                store.close()
+        retries = 0
         try:
-            return self._fit(state)
+            while True:
+                try:
+                    return self._fit(state)
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — failure recovery (§5)
+                    if retries >= self.cfg.run.max_retries:
+                        raise
+                    restored = None
+                    store = self._ckpt_store()
+                    if store is not None:
+                        latest = store.latest_step()
+                        if latest is not None and (
+                            baseline_step is None or latest > baseline_step
+                        ):
+                            restored, _ = store.restore(
+                                template=self.init_state()
+                            )
+                        store.close()
+                    if restored is None and caller_state:
+                        # the caller's warm-start state may have been
+                        # donated to the failed attempt's round dispatch;
+                        # with no checkpoint of our own there is nothing
+                        # safe to resume from
+                        raise
+                    retries += 1
+                    self.logger.log({
+                        "event": "retry",
+                        "attempt": retries,
+                        "round": None if restored is None else int(restored["round"]),
+                        "error": repr(e)[:200],
+                    })
+                    # drop any in-flight prefetch state from the failed
+                    # attempt; state=None restarts fresh (or re-resumes,
+                    # if this run was itself a --resume run)
+                    self._stop_prefetch()
+                    state = restored
         finally:
             self._stop_prefetch()
             # flush + join the TensorBoard writer thread (no-op without TB)

@@ -1,0 +1,116 @@
+"""Failure recovery (SURVEY.md §5): run.max_retries resumes a crashed
+round loop from the latest checkpoint and reproduces the uninterrupted
+trajectory exactly."""
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _cfg(tmp_path, rounds=4, retries=0):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.server.checkpoint_every = 1
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.max_retries = retries
+    cfg.data.synthetic_train_size = 128
+    cfg.data.synthetic_test_size = 64
+    return cfg
+
+
+class _FailOnce:
+    """Raises on the Nth run_round call, then behaves normally."""
+
+    def __init__(self, exp, fail_at_call):
+        self.inner = exp.run_round
+        self.calls = 0
+        self.fail_at = fail_at_call
+
+    def __call__(self, state, round_idx):
+        self.calls += 1
+        if self.calls == self.fail_at:
+            raise RuntimeError("injected fault")
+        return self.inner(state, round_idx)
+
+
+def test_retry_resumes_and_matches_straight_run(tmp_path):
+    straight = Experiment(_cfg(tmp_path / "straight"), echo=False).fit()
+
+    exp = Experiment(_cfg(tmp_path / "faulty", retries=1), echo=False)
+    exp.run_round = _FailOnce(exp, fail_at_call=3)  # crash in round 3
+    recovered = exp.fit()
+
+    assert int(recovered["round"]) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        straight["params"], recovered["params"],
+    )
+
+
+def test_no_retries_fails_fast(tmp_path):
+    exp = Experiment(_cfg(tmp_path, retries=0), echo=False)
+    exp.run_round = _FailOnce(exp, fail_at_call=2)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        exp.fit()
+
+
+def test_retries_exhausted_reraises(tmp_path):
+    exp = Experiment(_cfg(tmp_path, retries=2), echo=False)
+
+    def always_fail(state, round_idx):
+        raise RuntimeError("persistent fault")
+
+    exp.run_round = always_fail
+    with pytest.raises(RuntimeError, match="persistent fault"):
+        exp.fit()
+
+
+def test_retry_never_restores_stale_checkpoint_from_previous_run(tmp_path):
+    """A fresh run crashing in the same out_dir as a COMPLETED earlier
+    run must restart from scratch, not silently 'recover' the old run's
+    final params."""
+    Experiment(_cfg(tmp_path / "shared"), echo=False).fit()  # run A completes
+
+    exp_b = Experiment(_cfg(tmp_path / "shared", retries=1), echo=False)
+    exp_b.run_round = _FailOnce(exp_b, fail_at_call=1)  # crash before any B ckpt
+    recovered = exp_b.fit()
+    assert int(recovered["round"]) == 4
+
+    straight = Experiment(_cfg(tmp_path / "fresh2"), echo=False).fit()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        straight["params"], recovered["params"],
+    )
+
+
+def test_caller_state_without_checkpoint_reraises(tmp_path):
+    """A caller-provided warm start may have been donated to the failed
+    dispatch; with no checkpoint of our own, retrying silently from
+    fresh init would fake a recovery — re-raise instead."""
+    exp = Experiment(_cfg(tmp_path, retries=3), echo=False)
+    warm = exp.init_state()
+    exp.run_round = _FailOnce(exp, fail_at_call=1)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        exp.fit(state=warm)
+
+
+def test_failure_before_any_checkpoint_restarts_from_scratch(tmp_path):
+    exp = Experiment(_cfg(tmp_path / "fresh", retries=1), echo=False)
+    exp.run_round = _FailOnce(exp, fail_at_call=1)  # crash in round 1
+    recovered = exp.fit()
+    assert int(recovered["round"]) == 4
+    straight = Experiment(_cfg(tmp_path / "straight"), echo=False).fit()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        straight["params"], recovered["params"],
+    )

@@ -140,6 +140,60 @@ def test_dp_under_sharded_engine():
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(p))
 
 
+def test_clip_delta_norm_bounds_update():
+    """With per-client clipping at C and the plain-mean server (lr=1),
+    the global update is a convex combination of ≤C-norm deltas, so
+    ‖w_new − w_old‖ ≤ C."""
+    from colearn_federated_learning_tpu.utils import trees
+
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.5)  # hot lr → big deltas
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    clip = 0.05
+    fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(4),
+        server_update, cohort_size=8, donate=False, clip_delta_norm=clip,
+    )
+    p, _, _ = fn(params, init(params), x, y, jnp.asarray(idx),
+                 jnp.asarray(mask), jnp.asarray(n_ex), jax.random.PRNGKey(0))
+    moved = float(jnp.sqrt(trees.tree_sq_norm(trees.tree_sub(p, params))))
+    assert moved <= clip * 1.001, moved
+    # and without clipping the same round moves much further
+    fn0 = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(4),
+        server_update, cohort_size=8, donate=False,
+    )
+    p0, _, _ = fn0(params, init(params), x, y, jnp.asarray(idx),
+                   jnp.asarray(mask), jnp.asarray(n_ex), jax.random.PRNGKey(0))
+    moved0 = float(jnp.sqrt(trees.tree_sq_norm(trees.tree_sub(p0, params))))
+    assert moved0 > clip * 2, moved0
+
+
+def test_clip_delta_sharded_matches_sequential():
+    model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
+    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    init, server_update = make_server_update_fn(scfg)
+    kw = dict(clip_delta_norm=0.02)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", build_client_mesh(4),
+        server_update, cohort_size=8, donate=False, **kw,
+    )
+    sequential = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update, **kw,
+    )
+    args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
+            jax.random.PRNGKey(42))
+    p_sh, _, m_sh = sharded(params, init(params), *args)
+    p_sq, _, m_sq = sequential(params, init(params), *args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_sh, p_sq,
+    )
+    np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
+
+
 def test_largest_lane_count():
     assert largest_lane_count(16, 8) == 8
     assert largest_lane_count(12, 8) == 6

@@ -294,3 +294,7 @@ def test_scaffold_config_validation():
     cfg.server.compression = "qsgd"
     with pytest.raises(ValueError, match="compression"):
         cfg.validate()
+    cfg = _scaffold_cfg("unused")
+    cfg.server.clip_delta_norm = 1.0
+    with pytest.raises(ValueError, match="clip_delta_norm"):
+        cfg.validate()
