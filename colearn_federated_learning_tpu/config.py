@@ -100,6 +100,14 @@ class ServerConfig:
     # aggregation — the standard heterogeneity stabilizer (and DP-SGD's
     # clipping step without the noise). 0 = off.
     clip_delta_norm: float = 0.0
+    # algorithm=fedbuff only: client train durations are 1..S server
+    # steps (S = async_max_staleness); the pop-K-earliest-finish queue
+    # discipline bounds realized staleness by 2S, which sizes the
+    # on-device params-history ring (2S+1 versions). In-flight
+    # concurrency = cohort_size × S.
+    async_max_staleness: int = 4
+    # staleness decay exponent α: aggregation weight × (1+s)^-α
+    async_staleness_exponent: float = 0.5
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -187,7 +195,9 @@ class RunConfig:
 class ExperimentConfig:
     name: str = "mnist_fedavg_2"
     # fedavg | fedprox (prox_mu>0 implied) | scaffold (client control
-    # variates, Karimireddy et al. 2020 — needs plain client SGD)
+    # variates, Karimireddy et al. 2020 — needs plain client SGD) |
+    # fedbuff (asynchronous buffered aggregation, Nguyen et al. 2022 —
+    # clients train on stale versions, staleness-decayed weights)
     algorithm: str = "fedavg"
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
@@ -203,8 +213,30 @@ class ExperimentConfig:
             )
         if self.algorithm == "fedprox" and self.client.prox_mu <= 0:
             raise ValueError("fedprox requires client.prox_mu > 0")
-        if self.algorithm not in ("fedavg", "fedprox", "scaffold"):
+        if self.algorithm not in ("fedavg", "fedprox", "scaffold", "fedbuff"):
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.algorithm == "fedbuff":
+            if self.run.engine != "sharded":
+                raise ValueError("fedbuff requires run.engine=sharded")
+            if self.server.aggregator != "weighted_mean":
+                raise ValueError(
+                    "fedbuff is incompatible with robust server.aggregator"
+                )
+            if self.server.compression:
+                raise ValueError("fedbuff is incompatible with server.compression")
+            if self.run.batch_shards > 1:
+                raise ValueError("fedbuff is incompatible with run.batch_shards")
+            if self.server.sampling != "uniform":
+                raise ValueError(
+                    "fedbuff schedules clients via its own in-flight queue; "
+                    "server.sampling=weighted is not supported"
+                )
+            if self.data.placement != "hbm":
+                raise ValueError("fedbuff requires data.placement=hbm")
+            if self.server.async_max_staleness < 1:
+                raise ValueError("async_max_staleness must be >= 1")
+            if self.server.async_staleness_exponent < 0.0:
+                raise ValueError("async_staleness_exponent must be >= 0")
         if self.algorithm == "scaffold":
             # the option-II control-variate identity cᵢ⁺ = (w₀−w_K)/(K·lr)
             # assumes plain SGD local steps (Karimireddy et al. 2020 §3);

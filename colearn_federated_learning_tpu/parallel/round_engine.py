@@ -393,6 +393,150 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     return round_fn
 
 
+def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
+                        buffer_size: int, window: int, donate: bool = True,
+                        client_vmap_width: int = 1, local_dtype=None,
+                        clip_delta_norm: float = 0.0):
+    """Asynchronous buffered FL (FedBuff, Nguyen et al. 2022) — one
+    server step as one XLA program.
+
+    Clients train against STALE parameter versions: ``history`` is a
+    ``[window, ...]`` ring of past global params (replicated), each of
+    the ``buffer_size`` completing clients gathers its own start version
+    by slot index, trains, and contributes ``delta vs ITS start params``
+    weighted by the host-computed staleness decay. The server applies
+    the weighted mean to the CURRENT params and writes the new version
+    into the ring.
+
+    Signature of the returned fn::
+
+        (history, server_opt_state, train_x, train_y,
+         idx [K,steps,batch], mask [K,steps,batch], agg_w [K], n_ex [K],
+         slots [K] int32, cur_slot int32, next_slot int32, rng)
+        → (new_history, new_params, new_opt_state, RoundMetrics)
+
+    ``agg_w`` are the full aggregation weights (base weight × (1+s)^-α,
+    dropped clients 0) — staleness lives host-side in the scheduler
+    (server/round_driver.py), the program just consumes weights.
+    The batch axis / scaffold / robust / compression features of the
+    sync engine are deliberately not composed here (config.validate
+    rejects them with algorithm=fedbuff).
+    """
+    local_train = make_local_train_fn(
+        model, client_cfg, dp_cfg, task, local_dtype=local_dtype,
+    )
+    n_lanes = mesh.shape[CLIENT_AXIS]
+    if buffer_size % n_lanes != 0:
+        raise ValueError(
+            f"buffer {buffer_size} not divisible by lanes {n_lanes}"
+        )
+    clients_per_lane = buffer_size // n_lanes
+    width = client_vmap_width if client_vmap_width > 0 else clients_per_lane
+    if width > clients_per_lane or clients_per_lane % width != 0:
+        raise ValueError(
+            f"client_vmap_width {width} must divide the {clients_per_lane} "
+            f"clients per lane"
+        )
+    use_decay = client_cfg.lr_decay != 1.0
+
+    def lane_fn(history, train_x, train_y, idx, mask, agg_w, n_ex, slots,
+                keys, *rest):
+        lr_scale = rest[0] if use_decay else None
+        history = _pcast_varying(history)
+
+        def train_one(slot, b_idx, b_mask, key):
+            start = jax.tree.map(lambda h: jnp.take(h, slot, axis=0), history)
+            extra = () if lr_scale is None else (lr_scale,)
+            w, m = local_train(start, train_x, train_y, b_idx, b_mask, key,
+                               *extra)
+            delta = jax.tree.map(
+                lambda wi, p: wi.astype(jnp.float32) - p.astype(jnp.float32),
+                w, start,
+            )
+            return delta, m
+
+        def per_block(acc, inp):
+            b_idx, b_mask, b_w, b_n, b_slot, b_keys = inp
+            delta_b, m_b = jax.vmap(
+                train_one, in_axes=(0, 0, 0, 0),
+            )(b_slot, b_idx, b_mask, b_keys)
+            if clip_delta_norm > 0.0:
+                delta_b = _clip_block(delta_b, clip_delta_norm)
+            d_acc, w_acc, n_acc, l_acc = acc
+            d_acc = jax.tree.map(
+                lambda a, dd: a + jnp.einsum(
+                    "c,c...->...", b_w.astype(jnp.float32), dd
+                ).astype(a.dtype),
+                d_acc, delta_b,
+            )
+            return (d_acc, w_acc + b_w.sum(), n_acc + b_n.sum(),
+                    l_acc + (b_w * m_b.loss).sum()), None
+
+        n_blocks = idx.shape[0] // width
+        blocked = jax.tree.map(
+            lambda a: a.reshape((n_blocks, width) + a.shape[1:]),
+            (idx, mask, agg_w, n_ex, slots, keys),
+        )
+        d0 = jax.tree.map(
+            lambda h: jnp.zeros(h.shape[1:], jnp.float32), history
+        )
+        acc0 = _pcast_varying(
+            (d0, jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
+        )
+        (d_sum, w_sum, n_sum, l_sum), _ = jax.lax.scan(per_block, acc0, blocked)
+        d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
+        w_sum = jax.lax.psum(w_sum, CLIENT_AXIS)
+        n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
+        l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
+        denom = jnp.maximum(w_sum, 1e-30)
+        return trees.tree_scale(d_sum, 1.0 / denom), n_sum, l_sum / denom
+
+    in_specs = (P(), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                P(CLIENT_AXIS))
+    if use_decay:
+        in_specs += (P(),)
+    sharded_lane = jax.shard_map(
+        lane_fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P(), P()),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def round_fn(history, server_opt_state, train_x, train_y, idx, mask,
+                 agg_w, n_ex, slots, cur_slot, next_slot, rng):
+        # the ring size must agree with the host scheduler's slot
+        # arithmetic (versions % window) — a mismatch would gather stale
+        # params from the WRONG slot with no runtime error
+        for leaf in jax.tree.leaves(history):
+            if leaf.shape[0] != window:
+                raise ValueError(
+                    f"history ring has {leaf.shape[0]} slots, engine was "
+                    f"built for window={window}"
+                )
+            break
+        keys = jax.random.split(rng, idx.shape[0])
+        extra = ()
+        if use_decay:
+            extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+        mean_delta, n_total, mean_loss = sharded_lane(
+            history, train_x, train_y, idx, mask, agg_w, n_ex, slots, keys,
+            *extra,
+        )
+        current = jax.tree.map(
+            lambda h: jnp.take(h, cur_slot, axis=0), history
+        )
+        new_params, new_opt_state = server_update(
+            current, server_opt_state, mean_delta
+        )
+        new_history = jax.tree.map(
+            lambda h, p: h.at[next_slot].set(p.astype(h.dtype)),
+            history, new_params,
+        )
+        return (new_history, new_params, new_opt_state,
+                RoundMetrics(mean_loss, n_total))
+
+    return round_fn
+
+
 def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              local_dtype=None, agg: str = "examples",
                              scaffold: bool = False, num_clients: int = 0,

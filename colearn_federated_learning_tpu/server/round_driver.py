@@ -31,6 +31,7 @@ from colearn_federated_learning_tpu.data.loader import (
 from colearn_federated_learning_tpu.models import build_model
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
 from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_async_round_fn,
     make_sequential_round_fn,
     make_sharded_round_fn,
 )
@@ -73,6 +74,13 @@ class Experiment:
         # updated rows back (the one algorithm that forces a per-round
         # host sync — stateful clients are outside the pure round program)
         self.scaffold = cfg.algorithm == "scaffold"
+        # FedBuff (cfg.algorithm="fedbuff"): the server steps an
+        # asynchronous in-flight queue instead of sampling synchronous
+        # cohorts — client completions are consumed K at a time, each
+        # trained against the stale params version it started from
+        # (kept in an on-device history ring), staleness-decayed.
+        self.fedbuff = cfg.algorithm == "fedbuff"
+        self._async_stats: Dict[int, float] = {}
         # Size-proportional sampling pairs with UNIFORM aggregation
         # weights: example-weighting on top of p∝size sampling would count
         # shard size twice (contribution ∝ size²). Uniform sampling keeps
@@ -81,6 +89,7 @@ class Experiment:
         # with-replacement limit; without-replacement cohorts cap a huge
         # client's inclusion probability at 1, mildly under-weighting it.)
         agg = "uniform" if cfg.server.sampling == "weighted" else "examples"
+        self._agg_mode = agg
 
         if cfg.run.engine == "sharded":
             batch_shards = max(1, cfg.run.batch_shards)
@@ -105,19 +114,29 @@ class Experiment:
             else:
                 lanes = mesh_lib.largest_lane_count(cfg.server.cohort_size, avail)
             self.mesh = mesh_lib.build_client_mesh(lanes, batch_shards=batch_shards)
-            self.round_fn = make_sharded_round_fn(
-                self.model, cfg.client, cfg.dp, self.task, self.mesh,
-                server_update, cfg.server.cohort_size,
-                client_vmap_width=cfg.run.client_vmap_width,
-                local_dtype=self._local_dtype(), agg=agg,
-                scaffold=self.scaffold, num_clients=self.fed.num_clients,
-                aggregator=cfg.server.aggregator,
-                trim_ratio=cfg.server.trim_ratio,
-                compression=cfg.server.compression,
-                topk_ratio=cfg.server.compression_topk_ratio,
-                qsgd_levels=cfg.server.compression_qsgd_levels,
-                clip_delta_norm=cfg.server.clip_delta_norm,
-            )
+            if self.fedbuff:
+                self.round_fn = make_async_round_fn(
+                    self.model, cfg.client, cfg.dp, self.task, self.mesh,
+                    server_update, buffer_size=cfg.server.cohort_size,
+                    window=2 * cfg.server.async_max_staleness + 1,
+                    client_vmap_width=cfg.run.client_vmap_width,
+                    local_dtype=self._local_dtype(),
+                    clip_delta_norm=cfg.server.clip_delta_norm,
+                )
+            else:
+                self.round_fn = make_sharded_round_fn(
+                    self.model, cfg.client, cfg.dp, self.task, self.mesh,
+                    server_update, cfg.server.cohort_size,
+                    client_vmap_width=cfg.run.client_vmap_width,
+                    local_dtype=self._local_dtype(), agg=agg,
+                    scaffold=self.scaffold, num_clients=self.fed.num_clients,
+                    aggregator=cfg.server.aggregator,
+                    trim_ratio=cfg.server.trim_ratio,
+                    compression=cfg.server.compression,
+                    topk_ratio=cfg.server.compression_topk_ratio,
+                    qsgd_levels=cfg.server.compression_qsgd_levels,
+                    clip_delta_norm=cfg.server.clip_delta_norm,
+                )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
             self._client_sharding = mesh_lib.client_sharded(self.mesh)
@@ -237,6 +256,23 @@ class Experiment:
                 lambda p: np.zeros((self.fed.num_clients,) + p.shape, np.float32),
                 params,
             )
+        if self.fedbuff:
+            s_max = self.cfg.server.async_max_staleness
+            window = 2 * s_max + 1
+            k = self.cfg.server.cohort_size
+            m = k * s_max  # in-flight concurrency
+            state["history"] = jax.tree.map(
+                lambda p: jnp.broadcast_to(p[None], (window,) + p.shape), params
+            )
+            qrng = np.random.default_rng((seed, 8191))
+            state["queue_clients"] = qrng.choice(
+                self.fed.num_clients, size=m,
+                replace=m > self.fed.num_clients,
+            ).astype(np.int32)
+            state["queue_versions"] = np.zeros(m, np.int32)
+            state["queue_finish"] = qrng.integers(1, s_max + 1, m).astype(np.int32)
+            state["queue_seq"] = np.arange(m, dtype=np.int32)
+            state["queue_next_seq"] = m
         return state
 
     def _place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
@@ -256,6 +292,15 @@ class Experiment:
                 else np.array(a, dtype=np.float32, copy=True),
                 state["c_clients"],
             )
+        if self.fedbuff:
+            if self._data_sharding is not None:
+                state["history"] = self._put_data(state["history"])
+            for key in ("queue_clients", "queue_versions", "queue_finish",
+                        "queue_seq"):
+                a = state[key]
+                if not (isinstance(a, np.ndarray) and a.flags.writeable):
+                    state[key] = np.array(a, dtype=np.int32, copy=True)
+            state["queue_next_seq"] = int(state["queue_next_seq"])
         return state
 
     def _host_inputs(self, round_idx: int):
@@ -273,6 +318,13 @@ class Experiment:
             idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+        mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng)
+        slab = self._stream_slab(idx) if self._stream else None
+        return cohort, idx, mask, n_ex, slab
+
+    def _apply_failures(self, mask, n_ex, k, host_rng):
+        """Straggler truncation + dropout zeroing — shared by the sync
+        cohort path and the async (fedbuff) scheduler."""
         if self.cfg.server.straggler_rate > 0:
             # simulated stragglers (SURVEY.md §5, FedProx's motivating
             # scenario): a fraction of the cohort completes only
@@ -280,7 +332,7 @@ class Experiment:
             # truncated, so the engine's padded-step machinery makes the
             # unfinished steps exact no-ops and the FedAvg weight (and
             # SCAFFOLD's Kᵢ) shrinks to the work actually done
-            strag = host_rng.random(len(cohort)) < self.cfg.server.straggler_rate
+            strag = host_rng.random(k) < self.cfg.server.straggler_rate
             if strag.any():
                 done = max(1, int(round(
                     self.cfg.server.straggler_work * self.shape.steps
@@ -291,13 +343,12 @@ class Experiment:
         if self.cfg.server.dropout_rate > 0:
             # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
             participate = (
-                host_rng.random(len(cohort)) >= self.cfg.server.dropout_rate
+                host_rng.random(k) >= self.cfg.server.dropout_rate
             )
             if not participate.any():
-                participate[host_rng.integers(len(cohort))] = True
+                participate[host_rng.integers(k)] = True
             n_ex = n_ex * participate.astype(np.float32)
-        slab = self._stream_slab(idx) if self._stream else None
-        return cohort, idx, mask, n_ex, slab
+        return mask, n_ex
 
     def _round_inputs(self, round_idx: int):
         fut = self._prefetch.pop(round_idx, None)
@@ -345,7 +396,82 @@ class Experiment:
         new_idx = inv.reshape(idx.shape).astype(np.int32)
         return new_idx, slab_x, slab_y
 
+    def _run_async_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
+        """One FedBuff server step: pop the K earliest-finishing in-flight
+        clients, train each against its stale start version (history
+        ring gather inside the program), aggregate with staleness-decayed
+        weights, start K replacement clients at the new version.
+
+        The pop-K-earliest discipline with durations ≤ S and concurrency
+        K·S bounds realized staleness by 2S (a finished client waits at
+        most concurrency/K = S further steps), which sizes the 2S+1-slot
+        ring — asserted, not assumed."""
+        cfg = self.cfg
+        s_max = cfg.server.async_max_staleness
+        window = 2 * s_max + 1
+        k = cfg.server.cohort_size
+        version = round_idx
+        host_rng = np.random.default_rng((cfg.run.seed, 6073, round_idx))
+
+        order = np.lexsort((state["queue_seq"], state["queue_finish"]))
+        pick = order[:k]
+        cohort = state["queue_clients"][pick].copy()
+        staleness = version - state["queue_versions"][pick]
+        assert (staleness >= 0).all() and (staleness <= 2 * s_max).all(), staleness
+        slots = (state["queue_versions"][pick] % window).astype(np.int32)
+        self._async_stats[round_idx] = float(staleness.mean())
+
+        idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+        mask, n_ex = self._apply_failures(mask, n_ex, k, host_rng)
+        base_w = (
+            n_ex if self._agg_mode == "examples"
+            else (n_ex > 0).astype(np.float32)
+        )
+        agg_w = (
+            base_w * (1.0 + staleness.astype(np.float32))
+            ** -cfg.server.async_staleness_exponent
+        )
+
+        put_c = lambda a: self._put(jnp.asarray(a), self._client_sharding)  # noqa: E731
+        rng = jax.random.fold_in(state["rng_key"], round_idx)
+        history, params, opt_state, metrics = self.round_fn(
+            state["history"], state["server_opt_state"],
+            self.train_x, self.train_y,
+            put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
+            put_c(n_ex), put_c(slots),
+            jnp.int32(version % window), jnp.int32((version + 1) % window),
+            rng,
+        )
+
+        # replace the popped clients: fresh draws starting at the NEW
+        # version, finishing 1..S steps from the next step
+        state["queue_clients"][pick] = host_rng.choice(
+            self.fed.num_clients, size=k, replace=k > self.fed.num_clients
+        ).astype(np.int32)
+        state["queue_versions"][pick] = version + 1
+        state["queue_finish"][pick] = (
+            round_idx + 1 + host_rng.integers(1, s_max + 1, k)
+        ).astype(np.int32)
+        nxt = state["queue_next_seq"]
+        state["queue_seq"][pick] = np.arange(nxt, nxt + k, dtype=np.int32)
+
+        return {
+            "history": history,
+            "params": params,
+            "server_opt_state": opt_state,
+            "round": round_idx + 1,
+            "rng_key": state["rng_key"],
+            "queue_clients": state["queue_clients"],
+            "queue_versions": state["queue_versions"],
+            "queue_finish": state["queue_finish"],
+            "queue_seq": state["queue_seq"],
+            "queue_next_seq": nxt + k,
+            "_metrics": metrics,
+        }
+
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
+        if self.fedbuff:
+            return self._run_async_round(state, round_idx)
         cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         if self.scaffold:
@@ -463,9 +589,17 @@ class Experiment:
 
     def _fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         cfg = self.cfg
-        store = None
-        if cfg.run.out_dir:
-            store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
+        store = self._ckpt_store()
+        try:
+            return self._fit_body(state, store)
+        finally:
+            # close on BOTH paths — a crashed attempt under run.max_retries
+            # must not leak an open orbax manager per retry
+            if store is not None:
+                store.close()
+
+    def _fit_body(self, state, store):
+        cfg = self.cfg
         if state is None:
             if cfg.run.resume and store and store.latest_step() is not None:
                 template = self.init_state()
@@ -519,6 +653,10 @@ class Experiment:
                 }
                 if cfg.dp.enabled:
                     record["dp_epsilon"] = round(self.dp_epsilon(ridx + 1), 4)
+                if ridx in self._async_stats:
+                    record["mean_staleness"] = round(
+                        self._async_stats.pop(ridx), 3
+                    )
                 if ridx == pending[-1][0]:
                     record["rounds_per_sec"] = round(rounds_per_sec, 4)
                     record["client_updates_per_sec_per_chip"] = round(updates_per_sec, 4)
@@ -561,7 +699,6 @@ class Experiment:
                 store.save(int(state["round"]),
                            {k: v for k, v in state.items() if k != "wall_time"},
                            force=True)
-            store.close()
         return state
 
     # ------------------------------------------------------------------
