@@ -191,6 +191,10 @@ class RunConfig:
     local_param_dtype: str = ""
 
 
+# the federated algorithms the driver implements (validate() + docs)
+ALGORITHMS = ("fedavg", "fedprox", "scaffold", "fedbuff")
+
+
 @dataclass
 class ExperimentConfig:
     name: str = "mnist_fedavg_2"
@@ -213,7 +217,7 @@ class ExperimentConfig:
             )
         if self.algorithm == "fedprox" and self.client.prox_mu <= 0:
             raise ValueError("fedprox requires client.prox_mu > 0")
-        if self.algorithm not in ("fedavg", "fedprox", "scaffold", "fedbuff"):
+        if self.algorithm not in ALGORITHMS:
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
         if self.algorithm == "fedbuff":
             if self.run.engine != "sharded":

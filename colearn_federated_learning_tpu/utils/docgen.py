@@ -1,0 +1,76 @@
+"""Generate the config reference (docs/CONFIG.md) from the dataclasses.
+
+The field/default tables are derived from the live dataclasses, so the
+committed doc cannot drift silently: ``tests/test_docs.py`` regenerates
+and compares. Field SEMANTICS live as comments in config.py (the single
+source of truth) — the doc links each section there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from colearn_federated_learning_tpu import config as config_mod
+
+_SECTIONS = [
+    ("model", config_mod.ModelConfig, "Model selection (zoo name + per-family kwargs)."),
+    ("data", config_mod.DataConfig, "Dataset, federation partition, placement."),
+    ("client", config_mod.ClientConfig, "Per-client local training."),
+    ("server", config_mod.ServerConfig,
+     "Round schedule, aggregation, algorithms' server-side knobs."),
+    ("dp", config_mod.DPConfig, "DP-SGD (per-example clip + noise, RDP accounting)."),
+    ("run", config_mod.RunConfig,
+     "Engine/mesh/dtype/ops switches (profiling, retries, host pipeline)."),
+]
+
+
+def _fmt(v) -> str:
+    if isinstance(v, str):
+        return f'`"{v}"`' if v else '`""`'
+    if isinstance(v, dict) and not v:
+        return "`{}`"
+    return f"`{v}`"
+
+
+def config_reference_markdown() -> str:
+    section_names = {s for s, _, _ in _SECTIONS}
+    top = [
+        f"`{f.name}` ({_fmt(f.default)})"
+        for f in dataclasses.fields(config_mod.ExperimentConfig)
+        if f.name not in section_names
+    ]
+    algos = " | ".join(config_mod.ALGORITHMS)
+    lines = [
+        "# Config reference",
+        "",
+        "Generated from the dataclasses in "
+        "`colearn_federated_learning_tpu/config.py` — semantics are "
+        "documented as comments there; this file lists every field and "
+        "its default. Regenerated + diffed by `tests/test_docs.py`.",
+        "",
+        f"Top-level `ExperimentConfig` fields: {', '.join(top)}; "
+        f"`algorithm` is one of {algos}. The sections below follow. Any "
+        "field is settable from the CLI with `--set section.field=value`.",
+        "",
+    ]
+    for section, cls, blurb in _SECTIONS:
+        lines += [f"## `{section}` — {cls.__name__}", "", blurb, "",
+                  "| field | default |", "|---|---|"]
+        for f in dataclasses.fields(cls):
+            if f.default is not dataclasses.MISSING:
+                default = f.default
+            else:
+                default = f.default_factory()
+            lines.append(f"| `{f.name}` | {_fmt(default)} |")
+        lines.append("")
+    names = config_mod.list_named_configs()
+    named = ", ".join(f"`{n}`" for n in names)
+    lines += [
+        "## Named configs",
+        "",
+        f"{named} — the {len(names)} shipped capability configs "
+        "(`colearn configs` lists them; `colearn fit --config <name>` "
+        "runs one).",
+        "",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,21 @@
+"""docs/CONFIG.md is generated from the live dataclasses — regenerate
+and diff so a config change can't silently leave the doc stale."""
+
+import os
+
+from colearn_federated_learning_tpu.utils.docgen import config_reference_markdown
+
+
+def test_config_reference_is_current():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "CONFIG.md",
+    )
+    with open(path) as f:
+        committed = f.read()
+    assert committed == config_reference_markdown(), (
+        "docs/CONFIG.md is stale — regenerate with:\n"
+        "  python -c \"from colearn_federated_learning_tpu.utils.docgen "
+        "import config_reference_markdown; "
+        "open('docs/CONFIG.md','w').write(config_reference_markdown())\""
+    )
