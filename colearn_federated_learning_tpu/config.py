@@ -108,6 +108,9 @@ class ServerConfig:
     async_max_staleness: int = 4
     # staleness decay exponent α: aggregation weight × (1+s)^-α
     async_staleness_exponent: float = 0.5
+    # algorithm=feddyn only: the dynamic-regularization coefficient α
+    # (both the client proximal pull and the server h-correction scale)
+    feddyn_alpha: float = 0.1
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -192,7 +195,7 @@ class RunConfig:
 
 
 # the federated algorithms the driver implements (validate() + docs)
-ALGORITHMS = ("fedavg", "fedprox", "scaffold", "fedbuff")
+ALGORITHMS = ("fedavg", "fedprox", "scaffold", "feddyn", "fedbuff")
 
 
 @dataclass
@@ -210,6 +213,11 @@ class ExperimentConfig:
     dp: DPConfig = field(default_factory=DPConfig)
     run: RunConfig = field(default_factory=RunConfig)
 
+    def _effective_local_dtype(self) -> str:
+        """The dtype local training actually runs in: local_param_dtype,
+        or — when empty — the server param dtype itself."""
+        return self.run.local_param_dtype or self.run.param_dtype
+
     def validate(self) -> "ExperimentConfig":
         if self.server.cohort_size > self.data.num_clients:
             raise ValueError(
@@ -219,6 +227,39 @@ class ExperimentConfig:
             raise ValueError("fedprox requires client.prox_mu > 0")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.algorithm == "feddyn":
+            if self.client.prox_mu > 0.0:
+                # the α/2‖w−w₀‖² term IS feddyn's regularizer; the engine
+                # injects prox_mu=feddyn_alpha itself
+                raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
+            if self.server.feddyn_alpha <= 0.0:
+                raise ValueError("feddyn requires server.feddyn_alpha > 0")
+            if self.dp.enabled:
+                raise ValueError("feddyn is incompatible with dp.enabled")
+            if self._effective_local_dtype() != "float32":
+                raise ValueError(
+                    "feddyn requires f32 local training (persistent gᵢ "
+                    "state accumulates w_K rounding error otherwise)"
+                )
+            if self.server.aggregator != "weighted_mean":
+                raise ValueError(
+                    "feddyn is incompatible with robust server.aggregator "
+                    "(the h recursion tracks raw deltas)"
+                )
+            if self.server.compression or self.server.clip_delta_norm > 0.0:
+                raise ValueError(
+                    "feddyn is incompatible with compression/clip_delta_norm "
+                    "(params would move by modified deltas while gᵢ/h track "
+                    "the raw trajectory)"
+                )
+            if self.server.optimizer != "mean" or self.server.server_lr != 1.0:
+                # the engine applies the paper's exact step and bypasses
+                # the optax server optimizer — a configured server_lr
+                # would be silently ignored, so reject it
+                raise ValueError(
+                    "feddyn defines its own server update; set "
+                    "server.optimizer=mean and server_lr=1.0"
+                )
         if self.algorithm == "fedbuff":
             if self.run.engine != "sharded":
                 raise ValueError("fedbuff requires run.engine=sharded")
@@ -257,14 +298,15 @@ class ExperimentConfig:
                 raise ValueError("scaffold is incompatible with client.prox_mu > 0")
             if self.dp.enabled:
                 raise ValueError("scaffold is incompatible with dp.enabled")
-            if self.run.local_param_dtype not in ("", "float32"):
+            if self._effective_local_dtype() != "float32":
                 # cᵢ⁺ divides (w₀−w_K) by K·lr; low-precision w_K bakes
                 # its rounding error (amplified ~1/(K·lr)) into the
                 # PERSISTENT control variates, which then re-enter every
                 # local gradient — keep local training f32 under scaffold
                 raise ValueError(
-                    "scaffold requires f32 local training "
-                    "(run.local_param_dtype='' or 'float32')"
+                    "scaffold requires f32 local training (effective "
+                    "local dtype is run.local_param_dtype or, when empty, "
+                    "run.param_dtype)"
                 )
             if self.server.aggregator != "weighted_mean":
                 # the c update (c += Σδc/N) has no robust equivalent: a

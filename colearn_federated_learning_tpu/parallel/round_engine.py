@@ -105,7 +105,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           trim_ratio: float = 0.1,
                           compression: str = "", topk_ratio: float = 0.01,
                           qsgd_levels: int = 256,
-                          clip_delta_norm: float = 0.0):
+                          clip_delta_norm: float = 0.0,
+                          feddyn_alpha: float = 0.0):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -157,7 +158,35 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     the coordinate-wise sort, so one XLA program per round still holds.
     Costs K× the aggregation memory/traffic of the psum path (inherent:
     order statistics need all K values).
+
+    ``feddyn_alpha`` > 0 activates FedDyn (Acar et al. 2021) on the
+    SAME stateful plumbing as scaffold (mutually exclusive): the
+    per-client state gᵢ enters as the gradient correction ``−gᵢ``, the
+    proximal pull ``α(w−w₀)`` is injected via prox_mu, afterwards
+    ``gᵢ⁺ = gᵢ − α·(w_K − w₀)`` (participants only), and the server
+    applies ``h ← h + ΣΔgᵢ/N;  w ← w₀ + Δ̄ − h/α`` (c_global carries h;
+    the server optimizer is bypassed — FedDyn defines its own update —
+    but the round counter still advances for LR decay).
     """
+    feddyn = feddyn_alpha > 0.0
+    if feddyn and scaffold:
+        raise ValueError("scaffold and feddyn are mutually exclusive")
+    if feddyn:
+        import dataclasses as _dc
+
+        # the α/2‖w−w₀‖² term of FedDyn's local objective rides the
+        # existing FedProx machinery
+        if client_cfg.prox_mu:
+            raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
+        if aggregator != "weighted_mean" or compression or clip_delta_norm > 0:
+            # params would move by the modified deltas while gᵢ/h track
+            # the raw trajectory — guard here too so direct engine
+            # callers can't bypass config.validate()
+            raise ValueError(
+                "feddyn is incompatible with robust aggregators, "
+                "compression, or delta clipping"
+            )
+        client_cfg = _dc.replace(client_cfg, prox_mu=feddyn_alpha)
     batch_sharded = has_batch_axis(mesh)
     if batch_sharded and client_cfg.batch_size % mesh.shape[BATCH_AXIS]:
         raise ValueError(
@@ -183,8 +212,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
-    if scaffold and num_clients <= 0:
-        raise ValueError("scaffold requires num_clients (for the c update)")
+    stateful = scaffold or feddyn
+    if stateful and num_clients <= 0:
+        raise ValueError("stateful algorithms require num_clients")
     if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
@@ -199,17 +229,22 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # per-lane data) type-check under shard_map's vma system.
         rest = list(rest)
         lr_scale = rest.pop(0) if use_decay else None
-        c_global, c_cohort = (rest.pop(0), rest.pop(0)) if scaffold else (None, None)
+        c_global, c_cohort = (rest.pop(0), rest.pop(0)) if stateful else (None, None)
         params = _pcast_varying(params)
-        if scaffold:
+        if stateful:
             c_global = _pcast_varying(c_global)
 
         def per_block(acc, inp):
-            if scaffold:
+            if stateful:
                 b_idx, b_mask, b_n, b_keys, b_c = inp
-                # SCAFFOLD correction (c − cᵢ), constant over the local
-                # phase; f32 leaf broadcast [..] − [width, ..]
-                corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, b_c)
+                if scaffold:
+                    # SCAFFOLD correction (c − cᵢ), constant over the
+                    # local phase; f32 leaf broadcast [..] − [width, ..]
+                    corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, b_c)
+                else:
+                    # FedDyn linear term: −gᵢ (the global h only enters
+                    # server-side)
+                    corr = jax.tree.map(jnp.negative, b_c)
                 w_b, m_b = jax.vmap(
                     local_train, in_axes=(None, None, None, 0, 0, 0, None, 0),
                 )(params, train_x, train_y, b_idx, b_mask, b_keys, lr_scale, corr)
@@ -249,7 +284,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     ).astype(a.dtype),
                     d_acc, delta_b,
                 )
-            if scaffold:
+            if stateful:
                 # Kᵢ = # non-padded steps, counted on the GLOBAL mask so
                 # batch shards agree on validity (same rule as the
                 # trainer's _global_count — a step whose valid examples
@@ -258,13 +293,23 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 if batch_sharded:
                     step_counts = jax.lax.psum(step_counts, BATCH_AXIS)
                 k_valid = (step_counts > 0).sum(-1).astype(jnp.float32)
-                lr_i = jnp.float32(client_cfg.lr)
-                if lr_scale is not None:
-                    lr_i = lr_i * lr_scale.astype(jnp.float32)
                 part = ((b_n > 0) & (k_valid > 0)).astype(jnp.float32)
-                new_c_block = _scaffold_c_update(
-                    b_c, c_global, params, w_b, k_valid, lr_i, part
-                )
+                if scaffold:
+                    lr_i = jnp.float32(client_cfg.lr)
+                    if lr_scale is not None:
+                        lr_i = lr_i * lr_scale.astype(jnp.float32)
+                    new_c_block = _scaffold_c_update(
+                        b_c, c_global, params, w_b, k_valid, lr_i, part
+                    )
+                else:
+                    # FedDyn: gᵢ⁺ = gᵢ − α·(w_K − w₀), participants only
+                    new_c_block = jax.tree.map(
+                        lambda gi, w0, wk: gi
+                        - feddyn_alpha
+                        * part.reshape((gi.shape[0],) + (1,) * (gi.ndim - 1))
+                        * (wk.astype(jnp.float32) - w0[None].astype(jnp.float32)),
+                        b_c, params, w_b,
+                    )
                 dc_acc = jax.tree.map(
                     lambda a, nc, ci: a + (nc - ci).sum(0), dc_acc, new_c_block, b_c
                 )
@@ -273,7 +318,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     l_acc + (b_w * m_b.loss).sum(), dc_acc), ys
 
         n_blocks = idx.shape[0] // width
-        scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if scaffold else ())
+        scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if stateful else ())
         blocked = jax.tree.map(
             lambda a: a.reshape((n_blocks, width) + a.shape[1:]), scan_in
         )
@@ -282,7 +327,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # match the f32 per-block increment)
         dc0 = (
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            if scaffold else jnp.zeros(())
+            if stateful else jnp.zeros(())
         )
         # robust modes emit per-client deltas as scan ys instead of the
         # weighted-sum accumulator — collapse that carry slot to a scalar
@@ -308,7 +353,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         else:
             d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
             out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / denom)
-        if scaffold:
+        if stateful:
             out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
             out["new_c"] = unblock(ys["c"])
         return out
@@ -321,14 +366,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     in_specs = (P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS))
     if use_decay:
         in_specs += (P(),)  # lr_scale scalar, replicated
-    if scaffold:
+    if stateful:
         in_specs += (P(), P(CLIENT_AXIS))  # c_global, c_cohort
     out_specs = {"n": P(), "loss": P()}
     if robust:
         out_specs["deltas"] = P(CLIENT_AXIS)
     else:
         out_specs["mean_delta"] = P()
-    if scaffold:
+    if stateful:
         out_specs["dc_sum"] = P()
         out_specs["new_c"] = P(CLIENT_AXIS)
     sharded_lane = jax.shard_map(
@@ -349,7 +394,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             return robust_reduce(out["deltas"], n_ex > 0, aggregator, trim_ratio)
         return out["mean_delta"]
 
-    if scaffold:
+    if stateful:
 
         @partial(jax.jit, donate_argnums=(0, 1, 8, 9) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
@@ -362,13 +407,30 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 params, train_x, train_y, idx, mask, n_ex, keys,
                 *extra, c_global, c_cohort,
             )
-            new_params, new_opt_state = server_update(
-                params, server_opt_state, _mean_delta(out, n_ex)
-            )
-            # c ← c + (1/N)·Σᵢ∈S Δcᵢ  (paper's |S|/N · mean over S)
+            # both algorithms accumulate their global state the same way:
+            # scaffold  c ← c + ΣΔcᵢ/N   (paper's |S|/N · mean over S)
+            # feddyn    h ← h + ΣΔgᵢ/N   (= h − α·(1/N)Σ(wᵢ−w₀))
             new_c_global = jax.tree.map(
                 lambda c, dc: c + dc / float(num_clients), c_global, out["dc_sum"]
             )
+            if feddyn:
+                # FedDyn server step: w ← w₀ + Δ̄ − h⁺/α; the configured
+                # server optimizer is bypassed (the paper defines the
+                # update), only the round counter advances
+                mean_delta = _mean_delta(out, n_ex)
+                new_params = jax.tree.map(
+                    lambda p, d, h: (
+                        p.astype(jnp.float32) + d - h / feddyn_alpha
+                    ).astype(p.dtype),
+                    params, mean_delta, new_c_global,
+                )
+                new_opt_state = dict(
+                    server_opt_state, round=server_opt_state["round"] + 1
+                )
+            else:
+                new_params, new_opt_state = server_update(
+                    params, server_opt_state, _mean_delta(out, n_ex)
+                )
             return (new_params, new_opt_state, new_c_global, out["new_c"],
                     RoundMetrics(out["loss"], out["n"]))
 
@@ -544,16 +606,32 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              trim_ratio: float = 0.1,
                              compression: str = "", topk_ratio: float = 0.01,
                              qsgd_levels: int = 256,
-                             clip_delta_norm: float = 0.0):
+                             clip_delta_norm: float = 0.0,
+                             feddyn_alpha: float = 0.0):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
-    engine is tested against (SURVEY.md §4.3). ``scaffold`` and
-    ``aggregator`` mirror the sharded engine's signature exactly."""
+    engine is tested against (SURVEY.md §4.3). ``scaffold``, ``feddyn``
+    and ``aggregator`` mirror the sharded engine's signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
-    if scaffold and num_clients <= 0:
-        raise ValueError("scaffold requires num_clients (for the c update)")
+    feddyn = feddyn_alpha > 0.0
+    if feddyn and scaffold:
+        raise ValueError("scaffold and feddyn are mutually exclusive")
+    if feddyn:
+        import dataclasses as _dc
+
+        if client_cfg.prox_mu:
+            raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
+        if aggregator != "weighted_mean" or compression or clip_delta_norm > 0:
+            raise ValueError(
+                "feddyn is incompatible with robust aggregators, "
+                "compression, or delta clipping"
+            )
+        client_cfg = _dc.replace(client_cfg, prox_mu=feddyn_alpha)
+    stateful = scaffold or feddyn
+    if stateful and num_clients <= 0:
+        raise ValueError("stateful algorithms require num_clients")
     if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
@@ -579,12 +657,15 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         new_cs = []
         dc_sum = (
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            if scaffold else None
+            if stateful else None
         )
         for c in range(k):
-            if scaffold:
+            if stateful:
                 c_i = jax.tree.map(lambda a: a[c], c_cohort)
-                corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, c_i)
+                if scaffold:
+                    corr = jax.tree.map(lambda cg, ci: cg - ci, c_global, c_i)
+                else:  # feddyn linear term
+                    corr = jax.tree.map(jnp.negative, c_i)
                 w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
                                        keys[c], lr_scale, corr)
                 # width-1 block through the SAME update helper as the
@@ -592,16 +673,25 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 k_valid = jnp.asarray(
                     [(jnp.asarray(mask[c]).sum(-1) > 0).sum()], jnp.float32
                 )
-                lr_i = jnp.float32(client_cfg.lr) * (
-                    lr_scale.astype(jnp.float32) if lr_scale is not None else 1.0
-                )
                 part = ((jnp.asarray(n_ex[c]) > 0) & (k_valid[0] > 0)).astype(
                     jnp.float32
                 )[None]
-                new_c_block = _scaffold_c_update(
-                    jax.tree.map(lambda a: a[None], c_i), c_global, params,
-                    jax.tree.map(lambda a: a[None], w_i), k_valid, lr_i, part,
-                )
+                if scaffold:
+                    lr_i = jnp.float32(client_cfg.lr) * (
+                        lr_scale.astype(jnp.float32) if lr_scale is not None else 1.0
+                    )
+                    new_c_block = _scaffold_c_update(
+                        jax.tree.map(lambda a: a[None], c_i), c_global, params,
+                        jax.tree.map(lambda a: a[None], w_i), k_valid, lr_i, part,
+                    )
+                else:
+                    new_c_block = jax.tree.map(
+                        lambda gi, w0, wk: gi[None]
+                        - feddyn_alpha * part[0]
+                        * (wk[None].astype(jnp.float32)
+                           - w0[None].astype(jnp.float32)),
+                        c_i, params, w_i,
+                    )
                 new_c = jax.tree.map(lambda a: a[0], new_c_block)
                 new_cs.append(new_c)
                 dc_sum = jax.tree.map(
@@ -651,16 +741,32 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 trees.tree_scale(acc, 1.0 / denom), params,
             )
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
-        new_params, new_opt_state = update(params, server_opt_state, mean_delta)
-        if scaffold:
+        if stateful:
             new_c_global = jax.tree.map(
                 lambda cg, dc: cg + dc / float(num_clients), c_global, dc_sum
             )
             new_c_cohort = jax.tree.map(
                 lambda *ls: jnp.stack(ls), *new_cs
             )
+            if feddyn:
+                # FedDyn server step (mirrors the sharded wrapper)
+                new_params = jax.tree.map(
+                    lambda p, d, h: (
+                        p.astype(jnp.float32) + d.astype(jnp.float32)
+                        - h / feddyn_alpha
+                    ).astype(p.dtype),
+                    params, mean_delta, new_c_global,
+                )
+                new_opt_state = dict(
+                    server_opt_state, round=server_opt_state["round"] + 1
+                )
+            else:
+                new_params, new_opt_state = update(
+                    params, server_opt_state, mean_delta
+                )
             return (new_params, new_opt_state, new_c_global, new_c_cohort,
                     RoundMetrics(mean_loss, n_total))
+        new_params, new_opt_state = update(params, server_opt_state, mean_delta)
         return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
 
     return round_fn

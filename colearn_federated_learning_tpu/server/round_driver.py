@@ -74,6 +74,10 @@ class Experiment:
         # updated rows back (the one algorithm that forces a per-round
         # host sync — stateful clients are outside the pure round program)
         self.scaffold = cfg.algorithm == "scaffold"
+        # FedDyn shares scaffold's state plumbing: c_global carries h,
+        # c_clients carries the per-client gᵢ corrections
+        self.feddyn = cfg.algorithm == "feddyn"
+        self.stateful = self.scaffold or self.feddyn
         # FedBuff (cfg.algorithm="fedbuff"): the server steps an
         # asynchronous in-flight queue instead of sampling synchronous
         # cohorts — client completions are consumed K at a time, each
@@ -89,6 +93,8 @@ class Experiment:
         # with-replacement limit; without-replacement cohorts cap a huge
         # client's inclusion probability at 1, mildly under-weighting it.)
         agg = "uniform" if cfg.server.sampling == "weighted" else "examples"
+        if self.feddyn:
+            agg = "uniform"  # the paper's plain mean over the cohort
         self._agg_mode = agg
 
         if cfg.run.engine == "sharded":
@@ -136,6 +142,9 @@ class Experiment:
                     topk_ratio=cfg.server.compression_topk_ratio,
                     qsgd_levels=cfg.server.compression_qsgd_levels,
                     clip_delta_norm=cfg.server.clip_delta_norm,
+                    feddyn_alpha=(
+                        cfg.server.feddyn_alpha if self.feddyn else 0.0
+                    ),
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -153,6 +162,9 @@ class Experiment:
                 topk_ratio=cfg.server.compression_topk_ratio,
                 qsgd_levels=cfg.server.compression_qsgd_levels,
                 clip_delta_norm=cfg.server.clip_delta_norm,
+                feddyn_alpha=(
+                    cfg.server.feddyn_alpha if self.feddyn else 0.0
+                ),
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -246,9 +258,9 @@ class Experiment:
             "round": 0,
             "rng_key": run_rng,
         }
-        if self.scaffold:
-            # c (replicated, on device at _place_state) + all-clients cᵢ
-            # (host numpy; only cohort rows travel to the device per round)
+        if self.stateful:
+            # scaffold: c (replicated) + all-clients cᵢ; feddyn: h + gᵢ —
+            # same shapes, host numpy; only cohort rows travel per round
             state["c_global"] = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
             )
@@ -280,9 +292,9 @@ class Experiment:
         if self._data_sharding is not None:
             state["params"] = self._put_data(state["params"])
             state["server_opt_state"] = self._put_data(state["server_opt_state"])
-            if self.scaffold:
+            if self.stateful:
                 state["c_global"] = self._put_data(state["c_global"])
-        if self.scaffold:
+        if self.stateful:
             # restored checkpoints arrive as jax arrays; the scatter path
             # needs writable host numpy (fresh init already is — don't
             # double several GB of per-client state for nothing)
@@ -474,7 +486,7 @@ class Experiment:
             return self._run_async_round(state, round_idx)
         cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
-        if self.scaffold:
+        if self.stateful:
             c_cohort = jax.tree.map(
                 lambda a: self._put(jnp.asarray(a[cohort]), self._client_sharding),
                 state["c_clients"],
