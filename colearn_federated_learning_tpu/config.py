@@ -218,6 +218,16 @@ class ExperimentConfig:
         or — when empty — the server param dtype itself."""
         return self.run.local_param_dtype or self.run.param_dtype
 
+    def _stateful_dtype_ok(self) -> bool:
+        """Stateful algorithms (scaffold/feddyn) need the WHOLE parameter
+        trajectory in f32: local training (w_K feeds the persistent
+        state) AND server params/delta accumulators (params must move by
+        exactly the deltas the f32 state tracks)."""
+        return (
+            self._effective_local_dtype() == "float32"
+            and self.run.param_dtype == "float32"
+        )
+
     def validate(self) -> "ExperimentConfig":
         if self.server.cohort_size > self.data.num_clients:
             raise ValueError(
@@ -236,10 +246,11 @@ class ExperimentConfig:
                 raise ValueError("feddyn requires server.feddyn_alpha > 0")
             if self.dp.enabled:
                 raise ValueError("feddyn is incompatible with dp.enabled")
-            if self._effective_local_dtype() != "float32":
+            if not self._stateful_dtype_ok():
                 raise ValueError(
-                    "feddyn requires f32 local training (persistent gᵢ "
-                    "state accumulates w_K rounding error otherwise)"
+                    "feddyn requires an f32 parameter trajectory "
+                    "(run.param_dtype=float32 and f32 local training) — "
+                    "the persistent gᵢ/h state tracks exact deltas"
                 )
             if self.server.aggregator != "weighted_mean":
                 raise ValueError(
@@ -298,15 +309,14 @@ class ExperimentConfig:
                 raise ValueError("scaffold is incompatible with client.prox_mu > 0")
             if self.dp.enabled:
                 raise ValueError("scaffold is incompatible with dp.enabled")
-            if self._effective_local_dtype() != "float32":
-                # cᵢ⁺ divides (w₀−w_K) by K·lr; low-precision w_K bakes
-                # its rounding error (amplified ~1/(K·lr)) into the
-                # PERSISTENT control variates, which then re-enter every
-                # local gradient — keep local training f32 under scaffold
+            if not self._stateful_dtype_ok():
+                # cᵢ⁺ divides (w₀−w_K) by K·lr; low-precision anywhere in
+                # the trajectory (local w_K OR the server params/delta
+                # accumulator) bakes rounding error into the PERSISTENT
+                # control variates, which re-enter every local gradient
                 raise ValueError(
-                    "scaffold requires f32 local training (effective "
-                    "local dtype is run.local_param_dtype or, when empty, "
-                    "run.param_dtype)"
+                    "scaffold requires an f32 parameter trajectory "
+                    "(run.param_dtype=float32 and f32 local training)"
                 )
             if self.server.aggregator != "weighted_mean":
                 # the c update (c += Σδc/N) has no robust equivalent: a

@@ -96,6 +96,53 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
     return jax.tree.map(leaf, b_c, c_global, params, w_b)
 
 
+def _feddyn_prepare(client_cfg, scaffold, feddyn_alpha, aggregator,
+                    compression, clip_delta_norm):
+    """FedDyn constraint checks + prox_mu=α injection, SHARED by both
+    engine factories so the guards and the injected objective can't
+    drift between the engine and its parity oracle."""
+    feddyn = feddyn_alpha > 0.0
+    if not feddyn:
+        return False, client_cfg
+    if scaffold:
+        raise ValueError("scaffold and feddyn are mutually exclusive")
+    if client_cfg.prox_mu:
+        raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
+    if aggregator != "weighted_mean" or compression or clip_delta_norm > 0:
+        # params would move by the modified deltas while gᵢ/h track the
+        # raw trajectory — guard here too so direct engine callers can't
+        # bypass config.validate()
+        raise ValueError(
+            "feddyn is incompatible with robust aggregators, "
+            "compression, or delta clipping"
+        )
+    import dataclasses as _dc
+
+    return True, _dc.replace(client_cfg, prox_mu=feddyn_alpha)
+
+
+def _feddyn_g_update(b_c, params, w_b, part, alpha: float):
+    """FedDyn ``gᵢ⁺ = gᵢ − α·(w_K − w₀)`` over a ``[width, ...]`` block,
+    participants only; f32 math. Shared by both engines."""
+    return jax.tree.map(
+        lambda gi, w0, wk: gi
+        - alpha * part.reshape((gi.shape[0],) + (1,) * (gi.ndim - 1))
+        * (wk.astype(jnp.float32) - w0[None].astype(jnp.float32)),
+        b_c, params, w_b,
+    )
+
+
+def _feddyn_server_step(params, mean_delta, h_new, alpha: float):
+    """FedDyn server update ``w ← w₀ + Δ̄ − h⁺/α``; f32 math with the
+    final cast back to the params dtype. Shared by both engines."""
+    return jax.tree.map(
+        lambda p, d, h: (
+            p.astype(jnp.float32) + d.astype(jnp.float32) - h / alpha
+        ).astype(p.dtype),
+        params, mean_delta, h_new,
+    )
+
+
 def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           cohort_size: int, donate: bool = True,
                           client_vmap_width: int = 1, local_dtype=None,
@@ -168,25 +215,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     the server optimizer is bypassed — FedDyn defines its own update —
     but the round counter still advances for LR decay).
     """
-    feddyn = feddyn_alpha > 0.0
-    if feddyn and scaffold:
-        raise ValueError("scaffold and feddyn are mutually exclusive")
-    if feddyn:
-        import dataclasses as _dc
-
-        # the α/2‖w−w₀‖² term of FedDyn's local objective rides the
-        # existing FedProx machinery
-        if client_cfg.prox_mu:
-            raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
-        if aggregator != "weighted_mean" or compression or clip_delta_norm > 0:
-            # params would move by the modified deltas while gᵢ/h track
-            # the raw trajectory — guard here too so direct engine
-            # callers can't bypass config.validate()
-            raise ValueError(
-                "feddyn is incompatible with robust aggregators, "
-                "compression, or delta clipping"
-            )
-        client_cfg = _dc.replace(client_cfg, prox_mu=feddyn_alpha)
+    feddyn, client_cfg = _feddyn_prepare(
+        client_cfg, scaffold, feddyn_alpha, aggregator, compression,
+        clip_delta_norm,
+    )
     batch_sharded = has_batch_axis(mesh)
     if batch_sharded and client_cfg.batch_size % mesh.shape[BATCH_AXIS]:
         raise ValueError(
@@ -302,13 +334,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         b_c, c_global, params, w_b, k_valid, lr_i, part
                     )
                 else:
-                    # FedDyn: gᵢ⁺ = gᵢ − α·(w_K − w₀), participants only
-                    new_c_block = jax.tree.map(
-                        lambda gi, w0, wk: gi
-                        - feddyn_alpha
-                        * part.reshape((gi.shape[0],) + (1,) * (gi.ndim - 1))
-                        * (wk.astype(jnp.float32) - w0[None].astype(jnp.float32)),
-                        b_c, params, w_b,
+                    new_c_block = _feddyn_g_update(
+                        b_c, params, w_b, part, feddyn_alpha
                     )
                 dc_acc = jax.tree.map(
                     lambda a, nc, ci: a + (nc - ci).sum(0), dc_acc, new_c_block, b_c
@@ -414,15 +441,11 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 lambda c, dc: c + dc / float(num_clients), c_global, out["dc_sum"]
             )
             if feddyn:
-                # FedDyn server step: w ← w₀ + Δ̄ − h⁺/α; the configured
-                # server optimizer is bypassed (the paper defines the
-                # update), only the round counter advances
-                mean_delta = _mean_delta(out, n_ex)
-                new_params = jax.tree.map(
-                    lambda p, d, h: (
-                        p.astype(jnp.float32) + d - h / feddyn_alpha
-                    ).astype(p.dtype),
-                    params, mean_delta, new_c_global,
+                # FedDyn server step; the configured server optimizer is
+                # bypassed (the paper defines the update), only the
+                # round counter advances
+                new_params = _feddyn_server_step(
+                    params, _mean_delta(out, n_ex), new_c_global, feddyn_alpha
                 )
                 new_opt_state = dict(
                     server_opt_state, round=server_opt_state["round"] + 1
@@ -615,20 +638,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     and ``aggregator`` mirror the sharded engine's signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
-    feddyn = feddyn_alpha > 0.0
-    if feddyn and scaffold:
-        raise ValueError("scaffold and feddyn are mutually exclusive")
-    if feddyn:
-        import dataclasses as _dc
-
-        if client_cfg.prox_mu:
-            raise ValueError("feddyn injects prox_mu=alpha; set prox_mu=0")
-        if aggregator != "weighted_mean" or compression or clip_delta_norm > 0:
-            raise ValueError(
-                "feddyn is incompatible with robust aggregators, "
-                "compression, or delta clipping"
-            )
-        client_cfg = _dc.replace(client_cfg, prox_mu=feddyn_alpha)
+    feddyn, client_cfg = _feddyn_prepare(
+        client_cfg, scaffold, feddyn_alpha, aggregator, compression,
+        clip_delta_norm,
+    )
     stateful = scaffold or feddyn
     if stateful and num_clients <= 0:
         raise ValueError("stateful algorithms require num_clients")
@@ -685,12 +698,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                         jax.tree.map(lambda a: a[None], w_i), k_valid, lr_i, part,
                     )
                 else:
-                    new_c_block = jax.tree.map(
-                        lambda gi, w0, wk: gi[None]
-                        - feddyn_alpha * part[0]
-                        * (wk[None].astype(jnp.float32)
-                           - w0[None].astype(jnp.float32)),
-                        c_i, params, w_i,
+                    new_c_block = _feddyn_g_update(
+                        jax.tree.map(lambda a: a[None], c_i), params,
+                        jax.tree.map(lambda a: a[None], w_i), part,
+                        feddyn_alpha,
                     )
                 new_c = jax.tree.map(lambda a: a[0], new_c_block)
                 new_cs.append(new_c)
@@ -749,13 +760,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 lambda *ls: jnp.stack(ls), *new_cs
             )
             if feddyn:
-                # FedDyn server step (mirrors the sharded wrapper)
-                new_params = jax.tree.map(
-                    lambda p, d, h: (
-                        p.astype(jnp.float32) + d.astype(jnp.float32)
-                        - h / feddyn_alpha
-                    ).astype(p.dtype),
-                    params, mean_delta, new_c_global,
+                new_params = _feddyn_server_step(
+                    params, mean_delta, new_c_global, feddyn_alpha
                 )
                 new_opt_state = dict(
                     server_opt_state, round=server_opt_state["round"] + 1
