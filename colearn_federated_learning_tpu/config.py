@@ -86,9 +86,14 @@ class ServerConfig:
     #   median | trimmed_mean — coordinate-wise Byzantine-robust
     #   statistics over per-client deltas (unweighted by design; costs
     #   K× the aggregation memory of the psum path)
+    #   krum — whole-update selection (Blanchard et al. 2017): keep the
+    #   one delta closest to its m−f−2 nearest neighbours
     aggregator: str = "weighted_mean"
     # fraction trimmed from EACH side per coordinate (trimmed_mean only)
     trim_ratio: float = 0.1
+    # krum only: assumed number of Byzantine clients f (neighbour count
+    # = participants − f − 2, clamped ≥ 1)
+    krum_byzantine: int = 0
     # Client-update (uplink) compression applied to each client's delta
     # BEFORE aggregation — simulates communication-constrained FL:
     #   "" (off) | topk (keep top fraction by magnitude per tensor)
@@ -345,8 +350,24 @@ class ExperimentConfig:
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in ("uniform", "weighted"):
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
-        if self.server.aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+        if self.server.aggregator not in (
+            "weighted_mean", "median", "trimmed_mean", "krum"
+        ):
             raise ValueError(f"unknown server.aggregator {self.server.aggregator!r}")
+        if self.server.krum_byzantine < 0:
+            raise ValueError(
+                f"server.krum_byzantine must be >= 0, "
+                f"got {self.server.krum_byzantine}"
+            )
+        if (self.server.aggregator == "krum"
+                and 2 * self.server.krum_byzantine + 2 >= self.server.cohort_size):
+            # Blanchard et al. 2017's resilience condition 2f + 2 < n —
+            # beyond it Krum provably cannot tolerate f colluders, so a
+            # config claiming that defense must not validate
+            raise ValueError(
+                "krum requires 2*krum_byzantine + 2 < cohort_size "
+                "(Blanchard et al. resilience bound)"
+            )
         if not 0.0 <= self.server.trim_ratio < 0.5:
             raise ValueError(
                 f"server.trim_ratio must be in [0, 0.5), got {self.server.trim_ratio}"

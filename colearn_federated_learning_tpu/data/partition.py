@@ -34,12 +34,24 @@ def dirichlet_partition(
     by proportions drawn from Dirichlet(α)·𝟙. Standard FL recipe (Hsu et al.).
 
     Re-draws until every client has ≥ ``min_size`` examples, which mirrors
-    the usual implementation and keeps downstream static shapes sane.
-    """
+    the usual implementation and keeps downstream static shapes sane. At
+    extreme α (near-label-pure splits) redraws can keep failing — e.g.
+    α≈0.05, 2 classes, 10 clients leaves most clients empty on every
+    draw — so after the retry budget a deterministic REPAIR moves
+    examples from the largest shards to the starved ones (one at a
+    time, largest-first) instead of raising; the result is still a
+    partition and still extremely label-skewed, and stays deterministic
+    in ``seed``."""
     rng = np.random.default_rng(seed)
     n = len(labels)
+    if n < num_clients * min_size:
+        raise ValueError(
+            f"dirichlet_partition: {n} examples cannot give {num_clients} "
+            f"clients ≥ {min_size} each"
+        )
+    shards: List[List[int]] = []
     for _attempt in range(100):
-        shards: List[List[int]] = [[] for _ in range(num_clients)]
+        shards = [[] for _ in range(num_clients)]
         for c in range(num_classes):
             idx_c = np.flatnonzero(labels == c)
             rng.shuffle(idx_c)
@@ -51,10 +63,15 @@ def dirichlet_partition(
         sizes = [len(s) for s in shards]
         if min(sizes) >= min_size:
             return [np.sort(np.array(s, np.int64)) for s in shards]
-    raise RuntimeError(
-        f"dirichlet_partition: could not satisfy min_size={min_size} with "
-        f"alpha={alpha}, n={n}, num_clients={num_clients}"
-    )
+    # repair the final draw: feed starved shards from the largest ones
+    while True:
+        sizes = np.array([len(s) for s in shards])
+        needy = int(sizes.argmin())
+        if sizes[needy] >= min_size:
+            break
+        donor = int(sizes.argmax())
+        shards[needy].append(shards[donor].pop())
+    return [np.sort(np.array(s, np.int64)) for s in shards]
 
 
 def natural_partition(

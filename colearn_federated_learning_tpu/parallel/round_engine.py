@@ -153,7 +153,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           compression: str = "", topk_ratio: float = 0.01,
                           qsgd_levels: int = 256,
                           clip_delta_norm: float = 0.0,
-                          feddyn_alpha: float = 0.0):
+                          feddyn_alpha: float = 0.0,
+                          byzantine_f: int = 0):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -247,7 +248,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     stateful = scaffold or feddyn
     if stateful and num_clients <= 0:
         raise ValueError("stateful algorithms require num_clients")
-    if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+    if aggregator not in ("weighted_mean", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
     use_decay = client_cfg.lr_decay != 1.0
@@ -418,7 +419,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
             # global [K, ...] stack, client-sharded; the coordinate-wise
             # sort runs as plain jnp under jit — GSPMD handles the lanes
-            return robust_reduce(out["deltas"], n_ex > 0, aggregator, trim_ratio)
+            return robust_reduce(out["deltas"], n_ex > 0, aggregator,
+                                 trim_ratio, byzantine_f)
         return out["mean_delta"]
 
     if stateful:
@@ -630,7 +632,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              compression: str = "", topk_ratio: float = 0.01,
                              qsgd_levels: int = 256,
                              clip_delta_norm: float = 0.0,
-                             feddyn_alpha: float = 0.0):
+                             feddyn_alpha: float = 0.0,
+                             byzantine_f: int = 0):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -645,7 +648,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     stateful = scaffold or feddyn
     if stateful and num_clients <= 0:
         raise ValueError("stateful algorithms require num_clients")
-    if aggregator not in ("weighted_mean", "median", "trimmed_mean"):
+    if aggregator not in ("weighted_mean", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
     from colearn_federated_learning_tpu.ops.compression import make_compressor
@@ -737,7 +740,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
 
             stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *deltas)
             mean_delta = robust_reduce(
-                stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio
+                stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio,
+                byzantine_f,
             )
         else:
             # deltas accumulate in f32; the final cast mirrors the sharded

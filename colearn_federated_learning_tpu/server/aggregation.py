@@ -29,8 +29,9 @@ def weighted_delta_mean(deltas, weights):
     return trees.tree_weighted_mean(deltas, weights)
 
 
-def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1):
-    """Coordinate-wise Byzantine-robust aggregate of stacked client deltas.
+def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1,
+                  byzantine_f: int = 0):
+    """Byzantine-robust aggregate of stacked client deltas.
 
     ``deltas``: ``[K, ...]`` tree (the cohort's updates); ``participation``:
     ``[K]`` 0/1 — non-participants (dropout, empty shards) are excluded
@@ -44,10 +45,17 @@ def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1):
       al. 2018); tolerates < m/2 corrupted clients per coordinate.
     - ``"trimmed_mean"`` — drop ``⌊trim_ratio·m⌋`` smallest and largest
       values per coordinate, average the rest (0 ≤ ratio < 0.5).
+    - ``"krum"``      — selection (Blanchard et al. 2017): return the ONE
+      participant delta whose summed squared distance to its
+      ``m − byzantine_f − 2`` nearest participant neighbours is
+      smallest (clamped ≥ 1 neighbour). Whole-update selection — a
+      poisoned update is discarded entirely rather than per-coordinate.
 
     Robust statistics are unweighted by design (a weighted median would
     re-open the attack surface weights provide). Math in f32. The result
     feeds the server optimizer exactly like the weighted mean."""
+    if mode == "krum":
+        return _krum(deltas, participation, byzantine_f)
     part = participation.astype(jnp.float32)
     m = part.sum().astype(jnp.int32)
     k = part.shape[0]
@@ -73,6 +81,42 @@ def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1):
         return (jnp.where(keep > 0, s, 0.0)).sum(0) / cnt
 
     return jax.tree.map(leaf, deltas)
+
+
+def _krum(deltas, participation, byzantine_f: int):
+    """Krum selection over a [K, ...] delta stack (see robust_reduce)."""
+    part = participation.astype(jnp.float32)
+    k = part.shape[0]
+    m = part.sum()
+    # pairwise squared distances summed over the whole tree, one [K, K]
+    # Gram accumulation per leaf (K is a cohort — tiny)
+    d2 = jnp.zeros((k, k), jnp.float32)
+    for leaf in jax.tree.leaves(deltas):
+        x = leaf.astype(jnp.float32).reshape(k, -1)
+        sq = (x * x).sum(-1)
+        d2 = d2 + jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (x @ x.T), 0.0)
+    inf = jnp.float32(jnp.inf)
+    alive = part > 0
+    pair_ok = alive[:, None] & alive[None, :]
+    d2 = jnp.where(pair_ok, d2, inf)
+    d2 = d2.at[jnp.arange(k), jnp.arange(k)].set(inf)  # exclude self
+    s = jnp.sort(d2, axis=1)  # each row: finite neighbours first
+    n_nb = jnp.maximum(m - byzantine_f - 2, 1.0)  # dynamic neighbour count
+    keep = (jnp.arange(k)[None, :] < n_nb).astype(jnp.float32)
+    scores = (jnp.where(keep > 0, s, 0.0)).sum(1)
+    # m == 1: the lone participant has no neighbours (score inf) — give
+    # it score 0 so argmin still selects a participant
+    scores = jnp.where(alive & (m > 1), scores, jnp.where(alive, 0.0, inf))
+    winner = jnp.argmin(scores)
+    # m == 0 (full dropout): every score is inf and argmin would pick an
+    # arbitrary NON-participant — return the zero update instead, like
+    # the median/trimmed_mean paths do
+    return jax.tree.map(
+        lambda d: jnp.where(
+            m > 0, jnp.take(d.astype(jnp.float32), winner, axis=0), 0.0
+        ),
+        deltas,
+    )
 
 
 def make_server_optimizer(cfg: ServerConfig) -> optax.GradientTransformation:

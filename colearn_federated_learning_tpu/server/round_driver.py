@@ -145,6 +145,7 @@ class Experiment:
                     feddyn_alpha=(
                         cfg.server.feddyn_alpha if self.feddyn else 0.0
                     ),
+                    byzantine_f=cfg.server.krum_byzantine,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -165,6 +166,7 @@ class Experiment:
                 feddyn_alpha=(
                     cfg.server.feddyn_alpha if self.feddyn else 0.0
                 ),
+                byzantine_f=cfg.server.krum_byzantine,
             )
             self._data_sharding = None
             self._cohort_sharding = None

@@ -89,6 +89,51 @@ def test_median_resists_corrupted_client():
     assert np.abs(poisoned.mean(0)).max() > 1e7
 
 
+def test_krum_selects_cluster_member_and_rejects_outlier():
+    """Krum returns exactly one of the inputs — a member of the dense
+    honest cluster, never the planted outlier (Blanchard et al. 2017)."""
+    rng = np.random.default_rng(4)
+    honest = rng.normal(size=(8, 12)).astype(np.float32) * 0.1
+    honest[5] = 100.0  # the Byzantine update
+    got = robust_reduce(
+        {"w": jnp.asarray(honest)}, jnp.ones((8,)) > 0, "krum",
+        byzantine_f=1,
+    )
+    out = np.asarray(got["w"])
+    matches = [i for i in range(8) if np.allclose(out, honest[i])]
+    assert matches and matches[0] != 5, matches
+
+
+def test_krum_excludes_non_participants():
+    rng = np.random.default_rng(9)
+    d = rng.normal(size=(6, 5)).astype(np.float32)
+    part = np.ones(6, bool)
+    part[[0, 3]] = False
+    got = np.asarray(
+        robust_reduce({"w": jnp.asarray(d)}, jnp.asarray(part), "krum")["w"]
+    )
+    matches = [i for i in range(6) if np.allclose(got, d[i])]
+    assert matches and part[matches[0]], matches
+
+
+def test_krum_single_participant_returns_it():
+    d = np.arange(12, dtype=np.float32).reshape(4, 3)
+    part = np.zeros(4, bool)
+    part[2] = True
+    got = np.asarray(
+        robust_reduce({"w": jnp.asarray(d)}, jnp.asarray(part), "krum")["w"]
+    )
+    np.testing.assert_allclose(got, d[2])
+
+
+def test_krum_zero_participants_returns_zero_update():
+    d = np.full((4, 3), 7.0, np.float32)
+    got = np.asarray(
+        robust_reduce({"w": jnp.asarray(d)}, jnp.zeros(4) > 0, "krum")["w"]
+    )
+    np.testing.assert_allclose(got, np.zeros(3))
+
+
 def _setup(cohort=8, n=256):
     model = build_model("lenet5", num_classes=10)
     params = init_params(model, (28, 28, 1), seed=0)
@@ -107,7 +152,7 @@ def _setup(cohort=8, n=256):
     return model, params, x, y, idx, mask, n_ex
 
 
-@pytest.mark.parametrize("aggregator", ["median", "trimmed_mean"])
+@pytest.mark.parametrize("aggregator", ["median", "trimmed_mean", "krum"])
 def test_robust_sharded_matches_sequential(aggregator):
     model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
     ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.9)
@@ -157,10 +202,22 @@ def test_robust_e2e_trains(tmp_path):
 
 def test_robust_config_validation():
     cfg = get_named_config("mnist_fedavg_2")
-    cfg.server.aggregator = "krum"
+    cfg.server.aggregator = "geometric_median"
     with pytest.raises(ValueError, match="aggregator"):
         cfg.validate()
     cfg = get_named_config("mnist_fedavg_2")
     cfg.server.trim_ratio = 0.5
     with pytest.raises(ValueError, match="trim_ratio"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")  # cohort 2
+    cfg.server.aggregator = "krum"
+    with pytest.raises(ValueError, match="krum"):
+        cfg.validate()  # 2 - 0 - 2 = 0 neighbours
+    cfg = get_named_config("cifar10_fedavg_100")  # cohort 16
+    cfg.server.aggregator = "krum"
+    cfg.server.krum_byzantine = 2
+    cfg.validate()
+    # Blanchard resilience bound: 2f + 2 < n — f=7 over cohort 16 fails
+    cfg.server.krum_byzantine = 7
+    with pytest.raises(ValueError, match="resilience"):
         cfg.validate()
