@@ -269,6 +269,7 @@ def build_federated_data(cfg: DataConfig, seed: int = 0, **model_kwargs) -> Fede
     loader = dataset_registry.get(cfg.name)
     tx, ty, ex, ey, meta, num_classes, task = loader(cfg, **model_kwargs)
     labels_for_partition = ty if task == "classify" else ty[:, 0]
+    part_info: dict = {}
     client_indices = partition_lib.partition(
         cfg.partition,
         labels=labels_for_partition,
@@ -277,8 +278,21 @@ def build_federated_data(cfg: DataConfig, seed: int = 0, **model_kwargs) -> Fede
         alpha=cfg.dirichlet_alpha,
         seed=seed,
         natural_groups=meta.get("natural_groups"),
+        info=part_info,
     )
-    meta = dict(meta, partition=cfg.partition)
+    meta = dict(meta, partition=cfg.partition, **part_info)
+    if part_info.get("repair_used"):
+        # the deterministic extreme-α repair changed the effective
+        # label-skew distribution — say so where the user will see it
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s partition (dirichlet alpha=%s) needed deterministic repair: "
+            "%d example(s) moved from the largest shards to starved ones; "
+            "the realized label skew is milder than the drawn one",
+            cfg.partition, part_info.get("repair_alpha"),
+            part_info.get("repair_moved", 0),
+        )
     return FederatedData(
         train_x=tx, train_y=ty, test_x=ex, test_y=ey,
         client_indices=client_indices, num_classes=num_classes, task=task, meta=meta,

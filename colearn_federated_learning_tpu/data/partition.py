@@ -28,7 +28,7 @@ def iid_partition(n: int, num_clients: int, seed: int) -> List[np.ndarray]:
 
 def dirichlet_partition(
     labels: np.ndarray, num_clients: int, num_classes: int, alpha: float, seed: int,
-    min_size: int = 1,
+    min_size: int = 1, info: Optional[dict] = None,
 ) -> List[np.ndarray]:
     """Label-skew non-IID: for each class, split its examples across clients
     by proportions drawn from Dirichlet(α)·𝟙. Standard FL recipe (Hsu et al.).
@@ -37,11 +37,15 @@ def dirichlet_partition(
     the usual implementation and keeps downstream static shapes sane. At
     extreme α (near-label-pure splits) redraws can keep failing — e.g.
     α≈0.05, 2 classes, 10 clients leaves most clients empty on every
-    draw — so after the retry budget a deterministic REPAIR moves
-    examples from the largest shards to the starved ones (one at a
-    time, largest-first) instead of raising; the result is still a
-    partition and still extremely label-skewed, and stays deterministic
-    in ``seed``."""
+    draw — so after the retry budget a deterministic REPAIR bulk-moves
+    examples from the largest shards to the starved ones instead of
+    raising; the result is still a partition and still extremely
+    label-skewed, and stays deterministic in ``seed``. The repair
+    changes the effective label-skew distribution, so it is SURFACED:
+    when ``info`` is passed, ``info["repair_used"]`` /
+    ``info["repair_moved"]`` record whether and how many examples were
+    relocated (threaded into ``FederatedData.meta`` and the run log by
+    data/core.py)."""
     rng = np.random.default_rng(seed)
     n = len(labels)
     if n < num_clients * min_size:
@@ -62,15 +66,36 @@ def dirichlet_partition(
                 shard.extend(part.tolist())
         sizes = [len(s) for s in shards]
         if min(sizes) >= min_size:
+            if info is not None:
+                info["repair_used"] = False
+                info["repair_moved"] = 0
             return [np.sort(np.array(s, np.int64)) for s in shards]
-    # repair the final draw: feed starved shards from the largest ones
-    while True:
-        sizes = np.array([len(s) for s in shards])
-        needy = int(sizes.argmin())
-        if sizes[needy] >= min_size:
-            break
-        donor = int(sizes.argmax())
-        shards[needy].append(shards[donor].pop())
+    # Repair the final draw: feed starved shards from the largest ones.
+    # Each starved shard's deficit is computed once and filled with bulk
+    # slices from the current largest donors (donors never drop below
+    # min_size, so repairs can't cascade) — O(num_clients·log) instead of
+    # one argmin/argmax pass per moved example, which matters at extreme
+    # α on large datasets where the total deficit can be tens of
+    # thousands of examples.
+    sizes = np.array([len(s) for s in shards])
+    moved = 0
+    for needy in np.flatnonzero(sizes < min_size):
+        deficit = min_size - int(sizes[needy])
+        while deficit > 0:
+            donor = int(sizes.argmax())
+            take = min(deficit, int(sizes[donor]) - min_size)
+            shards[needy].extend(shards[donor][-take:])
+            del shards[donor][-take:]
+            sizes[donor] -= take
+            sizes[needy] += take
+            deficit -= take
+            moved += take
+    if info is not None:
+        info["repair_used"] = True
+        info["repair_moved"] = moved
+        # the α actually drawn from — the 'natural' fallback calls this
+        # with a hardcoded α, not the config field
+        info["repair_alpha"] = alpha
     return [np.sort(np.array(s, np.int64)) for s in shards]
 
 
@@ -115,18 +140,20 @@ def partition(
     alpha: float,
     seed: int,
     natural_groups: Optional[Sequence[np.ndarray]] = None,
+    info: Optional[dict] = None,
 ) -> List[np.ndarray]:
     n = len(labels)
     if kind == "iid":
         return iid_partition(n, num_clients, seed)
     if kind == "dirichlet":
-        return dirichlet_partition(labels, num_clients, num_classes, alpha, seed)
+        return dirichlet_partition(labels, num_clients, num_classes, alpha, seed,
+                                   info=info)
     if kind == "natural":
         if natural_groups is None:
             # Synthetic stand-in for a LEAF natural split: heavy label skew +
             # heterogeneous sizes, which is what "natural" delivers in practice.
             return dirichlet_partition(labels, num_clients, num_classes,
-                                       alpha=0.3, seed=seed)
+                                       alpha=0.3, seed=seed, info=info)
         return natural_partition(natural_groups, num_clients, seed)
     if kind == "silo":
         if natural_groups is not None:

@@ -96,6 +96,30 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
     return jax.tree.map(leaf, b_c, c_global, params, w_b)
 
 
+def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm):
+    """Engine-level mirror of config.validate()'s scaffold/topk pairing
+    rejections, SHARED by both engine factories so a direct
+    ``make_*_round_fn`` caller can't build an unsound combination that
+    the config layer would have refused (e.g. a scaffold+median engine
+    whose c_global update silently stays a plain poisonable mean).
+    FedDyn's equivalent guard lives in ``_feddyn_prepare``."""
+    robust = aggregator != "weighted_mean"
+    if scaffold and (robust or compression or clip_delta_norm > 0.0):
+        # the c update (c += Σδc/N) has no robust equivalent and the
+        # modified deltas would desynchronize params from the c
+        # trajectory — same reasoning as config.validate()
+        raise ValueError(
+            "scaffold is incompatible with robust aggregators, "
+            "compression, or delta clipping"
+        )
+    if compression == "topk" and robust:
+        # sparse deltas make coordinate-wise order statistics run over
+        # mostly-zero coordinates — statistically meaningless
+        raise ValueError(
+            "compression='topk' (sparse) breaks robust aggregation"
+        )
+
+
 def _feddyn_prepare(client_cfg, scaffold, feddyn_alpha, aggregator,
                     compression, clip_delta_norm):
     """FedDyn constraint checks + prox_mu=α injection, SHARED by both
@@ -216,6 +240,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     the server optimizer is bypassed — FedDyn defines its own update —
     but the round counter still advances for LR decay).
     """
+    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm)
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -371,7 +396,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         w_sum = jax.lax.psum(w_sum, CLIENT_AXIS)
         n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
         l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
-        denom = jnp.maximum(w_sum, 1.0)
+        # weights here are integer example counts or 0/1 participation
+        # flags, so w_sum ∈ (0,1) is impossible — the where-form is
+        # exactly the max-with-1 floor, written to match the async engine
+        denom = jnp.where(w_sum > 0, w_sum, 1.0)
         unblock = lambda t: jax.tree.map(  # noqa: E731  [n_blocks,width,...]→[C,...]
             lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), t
         )
@@ -575,7 +603,12 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         w_sum = jax.lax.psum(w_sum, CLIENT_AXIS)
         n_sum = jax.lax.psum(n_sum, CLIENT_AXIS)
         l_sum = jax.lax.psum(l_sum, CLIENT_AXIS)
-        denom = jnp.maximum(w_sum, 1e-30)
+        # Async weights are FRACTIONAL (staleness decay), so a max-with-1
+        # floor would silently attenuate legitimate updates whenever
+        # w_sum < 1 — guard only the true all-dropout case, same
+        # degenerate-round semantics as the sync engine (zero delta,
+        # zero loss).
+        denom = jnp.where(w_sum > 0, w_sum, 1.0)
         return trees.tree_scale(d_sum, 1.0 / denom), n_sum, l_sum / denom
 
     in_specs = (P(), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
@@ -641,6 +674,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     and ``aggregator`` mirror the sharded engine's signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
+    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm)
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -732,7 +766,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
             losses.append(m_i.loss)
         n_total = jnp.asarray(n_ex).sum()
-        denom = jnp.maximum(jnp.sum(jnp.stack(weights)), 1.0)
+        w_sum = jnp.sum(jnp.stack(weights))
+        denom = jnp.where(w_sum > 0, w_sum, 1.0)
         if robust:
             from colearn_federated_learning_tpu.server.aggregation import (
                 robust_reduce,

@@ -197,7 +197,26 @@ class Experiment:
         else:
             self.train_x = put(jnp.asarray(self.fed.train_x))
             self.train_y = put(jnp.asarray(self.fed.train_y))
-        self._eval_fn = jax.jit(make_eval_fn(self.model, self.task))
+        eval_fn = make_eval_fn(self.model, self.task)
+        self._eval_fn = jax.jit(eval_fn)
+
+        # Full-test-set eval as ONE dispatch: lax.scan over the stacked
+        # eval batches instead of one jitted call per batch — at ImageNet
+        # scale (50k test / batch 64 ≈ 780 batches) the per-batch loop is
+        # host-dispatch-bound on a relayed chip. Parity with the per-batch
+        # loop is pinned by tests/test_e2e_mnist.py::test_eval_scan_parity.
+        def _eval_all(params, xb, yb, mb):
+            def body(acc, b):
+                l, c, n = eval_fn(params, *b)
+                return (acc[0] + l, acc[1] + c, acc[2] + n), None
+
+            acc, _ = jax.lax.scan(
+                body, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
+                (xb, yb, mb),
+            )
+            return acc
+
+        self._eval_all = jax.jit(_eval_all)
         # eval batches are fixed for the run: build + upload exactly once
         xb, yb, mb = eval_batches(
             self.fed.test_x, self.fed.test_y, cfg.client.batch_size
@@ -431,7 +450,13 @@ class Experiment:
         pick = order[:k]
         cohort = state["queue_clients"][pick].copy()
         staleness = version - state["queue_versions"][pick]
-        assert (staleness >= 0).all() and (staleness <= 2 * s_max).all(), staleness
+        if not ((staleness >= 0).all() and (staleness <= 2 * s_max).all()):
+            # a violated bound would gather params from a wrong/overwritten
+            # ring slot with no runtime error — must survive python -O
+            raise RuntimeError(
+                f"fedbuff staleness bound violated: {staleness} outside "
+                f"[0, {2 * s_max}] — history ring sizing is wrong"
+            )
         slots = (state["queue_versions"][pick] % window).astype(np.int32)
         self._async_stats[round_idx] = float(staleness.mean())
 
@@ -629,6 +654,14 @@ class Experiment:
                 state = self.init_state()
         state = self._place_state(state)
         start_round = int(state["round"])
+        if start_round == 0 and self.fed.meta.get("repair_used"):
+            # the Dirichlet extreme-α repair changed the realized label
+            # skew — record it in the run log so experiments at extreme α
+            # know their partition was patched (data/partition.py)
+            self.logger.log({
+                "event": "partition_repair",
+                "moved": int(self.fed.meta.get("repair_moved", 0)),
+            })
         t_start = time.perf_counter()
 
         # Rounds are DISPATCHED asynchronously; per-round metric scalars
@@ -709,10 +742,11 @@ class Experiment:
         flush(state)
         state["wall_time"] = time.perf_counter() - t_start
         if store:
+            store.wait()  # land in-flight async saves before deciding
             if store.latest_step() != int(state["round"]):
                 store.save(int(state["round"]),
                            {k: v for k, v in state.items() if k != "wall_time"},
-                           force=True)
+                           force=True, block=True)
         return state
 
     # ------------------------------------------------------------------
@@ -737,15 +771,7 @@ class Experiment:
 
     def evaluate(self, params) -> Dict[str, float]:
         xb, yb, mb = self._eval_data
-        loss_sum = jnp.zeros(())
-        correct_sum = jnp.zeros(())
-        n_sum = jnp.zeros(())
-        for i in range(xb.shape[0]):
-            l, c, n = self._eval_fn(params, xb[i], yb[i], mb[i])
-            loss_sum += l
-            correct_sum += c
-            n_sum += n
-        loss, acc, n = jax.device_get((loss_sum, correct_sum, n_sum))
+        loss, acc, n = jax.device_get(self._eval_all(params, xb, yb, mb))
         return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
 
     def evaluate_personalized(self, params, epochs: int = 1,

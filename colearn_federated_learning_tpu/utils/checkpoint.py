@@ -26,18 +26,42 @@ class CheckpointStore:
         os.makedirs(self.directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(self.directory)
 
-    def save(self, step: int, state: Dict[str, Any], force: bool = False):
+    def save(self, step: int, state: Dict[str, Any], force: bool = False,
+             block: bool = False):
+        """Persist ``state`` at ``step``.
+
+        ASYNC by default (SURVEY.md §5: "async checkpointing so the round
+        loop never blocks"): orbax's blocking portion only snapshots
+        device arrays to host, then the serialize+write runs on a
+        background thread while the round loop keeps dispatching. Host
+        numpy leaves (scaffold's c_clients, fedbuff's queue arrays) are
+        mutated in place between rounds, so they are copied here to keep
+        the in-flight snapshot consistent. ``block=True`` restores the
+        synchronous behavior for final/retry-critical saves."""
         # rng keys aren't directly serializable; store raw key data
         state = dict(state)
         if "rng_key" in state:
             state["rng_key"] = np.asarray(jax.random.key_data(state["rng_key"]))
+        if not block:
+            state = jax.tree.map(
+                lambda a: np.array(a, copy=True)
+                if isinstance(a, np.ndarray) else a,
+                state,
+            )
         self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if block:
+            self._mngr.wait_until_finished()
+
+    def wait(self):
+        """Join any in-flight async save."""
         self._mngr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
     def restore(self, step: Optional[int] = None, template: Optional[Dict[str, Any]] = None):
+        # an in-flight async save must land before it can be restored
+        self._mngr.wait_until_finished()
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -59,4 +83,6 @@ class CheckpointStore:
         return restored, step
 
     def close(self):
+        # joins in-flight async saves before releasing the manager
+        self._mngr.wait_until_finished()
         self._mngr.close()

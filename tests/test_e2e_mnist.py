@@ -76,3 +76,22 @@ def test_cli_configs_lists_all(capsys):
     assert cli_main(["configs"]) == 0
     out = capsys.readouterr().out.split()
     assert "cifar10_fedavg_100" in out and len(out) == 5
+
+
+def test_eval_scan_parity(tmp_path):
+    """The fused single-dispatch eval (lax.scan over stacked eval
+    batches) must agree with the per-batch jitted loop it replaced
+    (VERDICT r2 weak #3)."""
+    cfg = _smoke_cfg(tmp_path, rounds=2)
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    fused = exp.evaluate(state["params"])
+    xb, yb, mb = exp._eval_data
+    loss_sum = correct_sum = n_sum = 0.0
+    for i in range(xb.shape[0]):
+        l, c, n = exp._eval_fn(state["params"], xb[i], yb[i], mb[i])
+        loss_sum += float(l)
+        correct_sum += float(c)
+        n_sum += float(n)
+    assert abs(fused["eval_loss"] - loss_sum / n_sum) < 1e-5
+    assert abs(fused["eval_acc"] - correct_sum / n_sum) < 1e-6

