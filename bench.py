@@ -1,28 +1,43 @@
-"""Headline benchmark (BASELINE.json:2): FL rounds/sec and
-client-updates/sec/chip on the 100-client CIFAR-10 ResNet-18 config,
-plus MFU accounting (XLA-counted FLOPs vs the chip's bf16 peak).
+"""Benchmark harness (BASELINE.json:2): FL rounds/sec and
+client-updates/sec/chip, plus MFU accounting (XLA-counted FLOPs vs the
+chip's bf16 peak).
 
-Prints ONE JSON line:
+Default (what the driver runs): the headline config
+``cifar10_fedavg_100`` — prints ONE JSON line::
+
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-``vs_baseline`` is relative to OUR first recorded TPU measurement in
-BASELINE.md (the reference publishes no numbers — BASELINE.json:13
-``"published": {}`` — so our own first light-up is the baseline the
-driver tracks improvement against).
+Matrix mode (VERDICT r2 missing-#4 — a perf record for every TPU
+config, so regressions in those paths are measurable)::
+
+    python bench.py --config femnist_fedprox_500   # one line, that config
+    python bench.py --matrix                        # one line per config
+
+``vs_baseline`` is relative to OUR first recorded TPU measurement of the
+same config in BASELINE.md (the reference publishes no numbers —
+BASELINE.json:13 ``"published": {}``); a config measured for the first
+time reports vs_baseline=1.0 and its number becomes the baseline.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
-# First recorded rounds/sec on 1× TPU v5 lite (see BASELINE.md measurements
-# table): 2026-07-29, commit of milestone S0-S2. Later entries in that table
-# track improvements against this number (bench reports vs_baseline).
-BASELINE_ROUNDS_PER_SEC = 2.22
-
-WARMUP_ROUNDS = 2
-TIMED_ROUNDS = 8
+# First recorded rounds/sec per config on 1× TPU v5 lite (BASELINE.md
+# measurements tables). The headline baseline is the 2026-07-29 S0-S2
+# first light-up; the other configs' baselines are their round-3 first
+# measurements.
+BASELINES = {
+    "cifar10_fedavg_100": 2.22,
+    # round-3 first measurements through THIS bench path (BASELINE.md
+    # round-3 table; the dispatch-bound configs vary ~2× with relay load)
+    "cifar10_fedavg_1000": 3.05,
+    "femnist_fedprox_500": 5.90,
+    "shakespeare_fedavg": 6.71,
+    "imagenet_silo_dp": 0.31,
+}
 
 # Dense bf16 peak of one TPU v5e (v5 lite) chip. MFU = achieved/peak; the
 # FLOP count comes from XLA's cost model of ONE scan-free train step
@@ -30,15 +45,29 @@ TIMED_ROUNDS = 8
 # whole-round program can't be cost-analyzed directly.
 PEAK_BF16_FLOPS = 197e12
 
+# Per-config bench shape: (warmup rounds, timed rounds, extra overrides).
+# Overrides only bound BENCH COST (round count, per-client caps, eval
+# off) — engine, algorithm, model family, partition kind, and DP are the
+# config's own. The imagenet cap keeps a ViT-B/16 DP round at seconds,
+# not minutes; recorded in the JSON so the number is honest.
+_SHAPES = {
+    "cifar10_fedavg_100": (2, 16, {}),
+    "cifar10_fedavg_1000": (2, 8, {}),
+    "femnist_fedprox_500": (2, 8, {}),
+    "shakespeare_fedavg": (2, 16, {}),
+    "imagenet_silo_dp": (1, 3, {"data.max_examples_per_client": 128}),
+}
+
 
 def _round_flops(exp, state):
     """Analytic FLOPs of one round: XLA-counted FLOPs of a single
     SCAN-FREE train step (value_and_grad on one batch) × local steps ×
     cohort size. The whole-round program cannot be cost-analyzed
     directly — XLA's cost model counts a ``lax.scan`` body ONCE, not
-    ×trip-count, under-reporting the 128-step round by ~128×. Optimizer
+    ×trip-count, under-reporting a 128-step round by ~128×. Optimizer
     + psum + server-update FLOPs are elementwise (≪1% of fwd+bwd) and
-    ignored. Returns None if the backend exposes no cost model."""
+    ignored; DP's per-example gradients cost the same matmul FLOPs as
+    the batched backward. Returns None if the backend has no cost model."""
     import jax
     import jax.numpy as jnp
 
@@ -61,21 +90,45 @@ def _round_flops(exp, state):
         return None
 
 
-def main():
+def _hbm_stats():
+    """Peak/in-use device memory if the backend exposes it (HBM headroom
+    for the north-star scale record); None otherwise."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return None
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_in_use_gib"] = round(stats["bytes_in_use"] / 2**30, 2)
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_gib"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
+    if "bytes_limit" in stats:
+        out["hbm_limit_gib"] = round(stats["bytes_limit"] / 2**30, 2)
+    return out or None
+
+
+def bench_config(name: str):
     import jax
 
     from colearn_federated_learning_tpu.config import get_named_config
     from colearn_federated_learning_tpu.server.round_driver import Experiment
 
-    cfg = get_named_config("cifar10_fedavg_100")
-    cfg.server.num_rounds = WARMUP_ROUNDS + TIMED_ROUNDS
+    warmup, timed, overrides = _SHAPES[name]
+    cfg = get_named_config(name)
+    cfg.server.num_rounds = warmup + timed
     cfg.server.eval_every = 0
     cfg.server.checkpoint_every = 0
     cfg.run.out_dir = ""
-    # synthetic CIFAR-sized corpus (real CIFAR absent in this sandbox: zero
-    # egress). Same shapes/cardinality as the real thing: 50k train examples.
-    cfg.data.synthetic_train_size = 50_000
-    cfg.data.synthetic_test_size = 1_000
+    # synthetic corpora at the real datasets' cardinality (zero egress —
+    # real files absent); the per-config synthetic sizes already match
+    # except the 100-client config, pinned at CIFAR's 50k here
+    if name == "cifar10_fedavg_100":
+        cfg.data.synthetic_train_size = 50_000
+        cfg.data.synthetic_test_size = 1_000
+    cfg.apply_overrides(overrides)
+    cfg.validate()
 
     exp = Experiment(cfg, echo=False)
     state = exp.init_state()
@@ -87,33 +140,36 @@ def main():
     # ends with ONE metrics drain, which forces execution of every round
     # (each depends on the previous round's params). block_until_ready
     # alone does not sync through the axon remote-execution relay.
-    for r in range(WARMUP_ROUNDS):
+    for r in range(warmup):
         state = exp.run_round(state, r)
         last_loss = float(state.pop("_metrics").train_loss)
 
     t0 = time.perf_counter()
     pending = []
-    for r in range(WARMUP_ROUNDS, WARMUP_ROUNDS + TIMED_ROUNDS):
+    for r in range(warmup, warmup + timed):
         state = exp.run_round(state, r)
         pending.append(state.pop("_metrics"))
     fetched = jax.device_get(pending)
     last_loss = float(fetched[-1].train_loss)
     dt = time.perf_counter() - t0
 
-    rounds_per_sec = TIMED_ROUNDS / dt
+    rounds_per_sec = timed / dt
     updates_per_sec_per_chip = (
-        TIMED_ROUNDS * cfg.server.cohort_size / dt / exp.n_chips
+        timed * cfg.server.cohort_size / dt / exp.n_chips
     )
-    vs = rounds_per_sec / BASELINE_ROUNDS_PER_SEC if BASELINE_ROUNDS_PER_SEC else 1.0
+    baseline = BASELINES.get(name)
+    vs = rounds_per_sec / baseline if baseline else 1.0
     extra = {
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
         "n_chips": exp.n_chips,
-        "timed_rounds": TIMED_ROUNDS,
+        "timed_rounds": timed,
         "platform": jax.devices()[0].platform,
         "data_source": exp.fed.meta.get("source"),
         "final_train_loss": round(last_loss, 4),
         "param_dtype": cfg.run.param_dtype,
     }
+    for k, v in overrides.items():
+        extra[f"override:{k}"] = v
     if flops_per_round:
         achieved = flops_per_round * rounds_per_sec
         extra.update({
@@ -121,13 +177,49 @@ def main():
             "achieved_tflops": round(achieved / 1e12, 2),
             "mfu_pct": round(100.0 * achieved / (PEAK_BF16_FLOPS * exp.n_chips), 2),
         })
-    print(json.dumps({
-        "metric": "FL rounds/sec (100-client CIFAR-10, ResNet-18, cohort 16)",
+    hbm = _hbm_stats()
+    if hbm:
+        extra.update(hbm)
+    d = cfg.data
+    return {
+        "metric": (
+            f"FL rounds/sec ({d.num_clients}-client {d.name}, "
+            f"{cfg.model.name}, cohort {cfg.server.cohort_size})"
+        ),
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(vs, 4),
         "extra": extra,
-    }))
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default="cifar10_fedavg_100",
+                    choices=sorted(_SHAPES))
+    ap.add_argument("--matrix", action="store_true",
+                    help="bench every config; one JSON line each")
+    args = ap.parse_args(argv)
+    if not args.matrix:
+        print(json.dumps(bench_config(args.config)), flush=True)
+        return
+    # Matrix mode re-execs one subprocess per config: each gets a clean
+    # process (allocator stats aren't cumulative across configs, no
+    # cross-config executable-cache contamination of HBM numbers).
+    import subprocess
+    import sys
+
+    for name in sorted(_SHAPES):
+        proc = subprocess.run(
+            [sys.executable, __file__, "--config", name],
+            capture_output=True, text=True,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        if proc.returncode != 0 or not line.startswith("{"):
+            record = {"config": name, "error": proc.stderr[-500:]}
+        else:
+            record = dict(json.loads(line), config=name)
+        print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,17 @@ def make_client_optimizer(cfg: ClientConfig) -> optax.GradientTransformation:
     return opt
 
 
+def normalize_input(x):
+    """uint8 image corpora are stored RAW (4× the HBM capacity and 4× the
+    host→device bandwidth of f32 — data/core.py); the [0,1] scaling
+    happens here on device, where XLA fuses it into the first conv's
+    input handling. Float inputs pass through untouched, int token ids
+    (LM task) are never uint8."""
+    if x.dtype == jnp.uint8:
+        return x.astype(jnp.float32) * (1.0 / 255.0)
+    return x
+
+
 def make_loss_fn(model, task: str, reduction: str = "mean"):
     """Masked loss. classify: y [B] ints; lm: y [B,T] next tokens.
 
@@ -62,7 +73,7 @@ def make_loss_fn(model, task: str, reduction: str = "mean"):
     """
 
     def loss_fn(params, x, y, m):
-        logits = model.apply({"params": params}, x, train=True)
+        logits = model.apply({"params": params}, normalize_input(x), train=True)
         if task == "classify":
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         else:  # lm: mean over tokens within each example
@@ -80,7 +91,8 @@ def _select_tree(pred, new, old):
 
 
 def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str,
-                        batch_axis: str | None = None, local_dtype=None):
+                        batch_axis: str | None = None, local_dtype=None,
+                        scan_unroll: int = 1):
     """Build the pure local-training function for one client-round.
 
     ``batch_axis``: when the mesh carries a second axis that data-parallels
@@ -245,7 +257,8 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
             lambda x: x + vary0.astype(x.dtype), base_state
         )
         (params, _), weighted_losses = jax.lax.scan(
-            step, (global_params, opt_state0), (idx, mask, keys)
+            step, (global_params, opt_state0), (idx, mask, keys),
+            unroll=scan_unroll,
         )
         n = _global_count(mask)
         mean_loss = weighted_losses.sum() / jnp.maximum(n, 1.0)
@@ -260,7 +273,7 @@ def make_eval_fn(model, task: str):
     del loss_core  # eval computes sums, not means; kept for symmetry
 
     def eval_batch(params, x, y, m):
-        logits = model.apply({"params": params}, x, train=False)
+        logits = model.apply({"params": params}, normalize_input(x), train=False)
         if task == "classify":
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             correct = (jnp.argmax(logits, -1) == y).astype(jnp.float32)

@@ -159,6 +159,11 @@ class RunConfig:
     # width × batch_size keeps the MXU fed for small models); 1 = pure
     # sequential scan (min memory), 0 = whole lane in one vmap
     client_vmap_width: int = 1
+    # Unroll factor for the client's local-step lax.scan (jax's native
+    # `unroll=`): >1 trades compile time / code size for fewer loop
+    # iterations and cross-step fusion opportunities; lax.scan handles
+    # non-dividing step counts itself. 1 = no unrolling.
+    scan_unroll: int = 1
     # Failure recovery (SURVEY.md §5): on an unexpected error inside the
     # round loop, reload the latest checkpoint and continue, up to this
     # many times per fit() call. 0 = fail fast. Requires out_dir +
@@ -415,6 +420,10 @@ class ExperimentConfig:
             )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
+        if self.run.scan_unroll < 1:
+            raise ValueError(
+                f"run.scan_unroll must be >= 1, got {self.run.scan_unroll}"
+            )
         if self.data.placement not in ("hbm", "stream"):
             raise ValueError(f"unknown data.placement {self.data.placement!r}")
         for f in ("param_dtype", "compute_dtype"):
@@ -527,6 +536,36 @@ def _cifar10_fedavg_100() -> ExperimentConfig:
     )
 
 
+def _cifar10_fedavg_1000() -> ExperimentConfig:
+    """The NORTH-STAR scale config (BASELINE.json:5): FedAvg, 1000 clients,
+    ResNet-18 on CIFAR-10 Dirichlet non-IID, cohort 64.
+
+    Same per-client workload as the headline ``cifar10_fedavg_100`` so
+    the two are directly comparable; only the federation size (1000
+    shards over the full 50k-example corpus — real CIFAR-10's
+    cardinality, mirrored by the synthetic fallback) and the cohort
+    (64) change. At ~50 examples/client the Dirichlet shards are small
+    and skewed; ``max_examples_per_client=128`` bounds the static pad
+    without truncating any but the largest shards."""
+    return ExperimentConfig(
+        name="cifar10_fedavg_1000",
+        algorithm="fedavg",
+        model=ModelConfig(name="resnet18", num_classes=10),
+        data=DataConfig(
+            name="cifar10",
+            num_clients=1000,
+            partition="dirichlet",
+            dirichlet_alpha=0.5,
+            synthetic_train_size=50_000,
+            synthetic_test_size=2_000,
+            max_examples_per_client=128,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
+        server=ServerConfig(num_rounds=1000, cohort_size=64, eval_every=20),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+    )
+
+
 def _femnist_fedprox_500() -> ExperimentConfig:
     """BASELINE config #3: FedProx, 500 clients, MobileNetV2 on FEMNIST (LEAF)."""
     return ExperimentConfig(
@@ -593,6 +632,7 @@ def _imagenet_silo_dp() -> ExperimentConfig:
 _NAMED = {
     "mnist_fedavg_2": _mnist_fedavg_2,
     "cifar10_fedavg_100": _cifar10_fedavg_100,
+    "cifar10_fedavg_1000": _cifar10_fedavg_1000,
     "femnist_fedprox_500": _femnist_fedprox_500,
     "shakespeare_fedavg": _shakespeare_fedavg,
     "imagenet_silo_dp": _imagenet_silo_dp,

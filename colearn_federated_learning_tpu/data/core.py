@@ -70,12 +70,16 @@ def _synthetic_images(rng: np.random.Generator, n: int, templates: np.ndarray):
     The SAME templates generate train and test (only noise and label draws
     differ), so the task is learnable by a small convnet in a handful of
     rounds — what the convergence smoke tests (SURVEY.md §4.2) need.
+
+    Stored as RAW uint8 (like the real datasets' on-disk form): 4× less
+    HBM and 4× less host→device transfer than f32; the [0,1] scaling is
+    fused on device (client/trainer.py ``normalize_input``).
     """
     num_classes, shape = templates.shape[0], templates.shape[1:]
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     noise = rng.uniform(0.0, 1.0, size=(n,) + tuple(shape)).astype(np.float32)
     x = 0.7 * templates[y] + 0.3 * noise
-    return x.astype(np.float32), y
+    return np.clip(np.rint(x * 255.0), 0, 255).astype(np.uint8), y
 
 
 def _synthetic_text(rng: np.random.Generator, n: int, seq_len: int, vocab: int):
@@ -153,8 +157,9 @@ def _try_mnist_real(data_dir: str):
     if not os.path.exists(path):
         return None
     with np.load(path) as d:
-        tx = (d["x_train"].astype(np.float32) / 255.0)[..., None]
-        ex = (d["x_test"].astype(np.float32) / 255.0)[..., None]
+        # kept as raw uint8 — normalization happens on device
+        tx = d["x_train"].astype(np.uint8)[..., None]
+        ex = d["x_test"].astype(np.uint8)[..., None]
         return tx, d["y_train"].astype(np.int32), ex, d["y_test"].astype(np.int32)
 
 
@@ -166,7 +171,8 @@ def _try_cifar10_real(data_dir: str):
         with open(os.path.join(base, fname), "rb") as f:
             d = pickle.load(f, encoding="bytes")
         x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return x.astype(np.float32) / 255.0, np.array(d[b"labels"], np.int32)
+        # raw uint8 — normalization happens on device
+        return np.ascontiguousarray(x), np.array(d[b"labels"], np.int32)
     xs, ys = zip(*[read(f"data_batch_{i}") for i in range(1, 6)])
     tx, ty = np.concatenate(xs), np.concatenate(ys)
     ex, ey = read("test_batch")
@@ -199,7 +205,9 @@ def _try_imagenet_real(data_dir: str, test_fraction: float = 0.05):
         return None
 
     def to_float(x):
-        return x.astype(np.float32) / 255.0 if x.dtype == np.uint8 else x.astype(np.float32)
+        # uint8 silos stay raw (normalized on device); float silos are
+        # assumed pre-normalized by the institution and pass through
+        return x if x.dtype == np.uint8 else x.astype(np.float32)
 
     test_path = os.path.join(base, "test.npz")
     has_test = os.path.exists(test_path)

@@ -178,7 +178,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           qsgd_levels: int = 256,
                           clip_delta_norm: float = 0.0,
                           feddyn_alpha: float = 0.0,
-                          byzantine_f: int = 0):
+                          byzantine_f: int = 0,
+                          scan_unroll: int = 1):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -254,7 +255,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task,
         batch_axis=BATCH_AXIS if batch_sharded else None,
-        local_dtype=local_dtype,
+        local_dtype=local_dtype, scan_unroll=scan_unroll,
     )
     n_lanes = mesh.shape[CLIENT_AXIS]
     if cohort_size % n_lanes != 0:
@@ -511,7 +512,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         buffer_size: int, window: int, donate: bool = True,
                         client_vmap_width: int = 1, local_dtype=None,
-                        clip_delta_norm: float = 0.0):
+                        clip_delta_norm: float = 0.0, scan_unroll: int = 1):
     """Asynchronous buffered FL (FedBuff, Nguyen et al. 2022) — one
     server step as one XLA program.
 
@@ -539,6 +540,7 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     """
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task, local_dtype=local_dtype,
+        scan_unroll=scan_unroll,
     )
     n_lanes = mesh.shape[CLIENT_AXIS]
     if buffer_size % n_lanes != 0:

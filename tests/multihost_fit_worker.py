@@ -1,0 +1,75 @@
+"""Worker for the 2-process DRIVER-LEVEL multihost test (VERDICT r2
+missing-#2): runs ``Experiment.fit`` end-to-end — eval + orbax
+checkpointing + resume — under ``process_count=2`` with the client mesh
+spanning both processes. Exercises the ``host_local_array`` branch of
+``Experiment._put`` (dead in every single-process test) and orbax's
+collective save/restore. Run: multihost_fit_worker.py <pid> <nprocs>
+<port> <out_dir>.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port, out_dir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from colearn_federated_learning_tpu.parallel.distributed import initialize
+
+    initialize(f"127.0.0.1:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    import numpy as np
+
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    def cfg_for(rounds, resume):
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.data.num_clients = 8
+        cfg.data.synthetic_train_size = 256
+        cfg.data.synthetic_test_size = 64
+        cfg.server.cohort_size = 8
+        cfg.server.num_rounds = rounds
+        cfg.server.eval_every = 2
+        cfg.server.checkpoint_every = 2
+        cfg.run.num_lanes = 8  # the global mesh: 4 devices per process
+        cfg.run.metrics_flush_every = 2
+        cfg.run.out_dir = out_dir
+        cfg.run.resume = resume
+        return cfg.validate()
+
+    # phase 1: fresh 4-round fit with eval + periodic checkpoints
+    exp = Experiment(cfg_for(4, resume=False), echo=False)
+    state = exp.fit()
+    assert int(state["round"]) == 4, state["round"]
+
+    # phase 2: resume from the step-4 checkpoint, continue to 6
+    exp2 = Experiment(cfg_for(6, resume=True), echo=False)
+    state2 = exp2.fit()
+    assert int(state2["round"]) == 6, state2["round"]
+
+    ev = exp2.evaluate(state2["params"])
+    leaf0 = float(
+        np.asarray(jax.tree.leaves(state2["params"])[0]).reshape(-1)[0]
+    )
+    print(
+        f"MULTIHOST_FIT_OK pid={pid} round={int(state2['round'])} "
+        f"acc={ev['eval_acc']:.6f} loss={ev['eval_loss']:.6f} "
+        f"leaf0={leaf0:.6f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

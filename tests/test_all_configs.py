@@ -16,6 +16,12 @@ from colearn_federated_learning_tpu.server.round_driver import Experiment
 _SHRINK = {
     "mnist_fedavg_2": {},
     "cifar10_fedavg_100": {"data.num_clients": 16, "model.kwargs.width": 16},
+    # the north-star config keeps its FULL 1000-client federation — the
+    # point is sampling/partitioning/index-tensor behavior at that scale;
+    # only the model is narrowed (the blanket overrides shrink the cohort
+    # and per-client work, and _scaled_train_size floors the corpus at
+    # 32k examples so 1000 Dirichlet shards stay non-degenerate)
+    "cifar10_fedavg_1000": {"model.kwargs.width": 16},
     "femnist_fedprox_500": {
         "data.num_clients": 16,
         "model.kwargs.width_mult": 0.25,

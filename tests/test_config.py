@@ -8,11 +8,13 @@ from colearn_federated_learning_tpu.config import (
 )
 
 
-def test_five_named_configs_exist():
-    # BASELINE.json:7-11 — the five capability configs
+def test_named_configs_exist():
+    # BASELINE.json:7-11 — the five capability configs, plus the
+    # 1000-client north-star scale config (BASELINE.json:5)
     assert list_named_configs() == sorted([
         "mnist_fedavg_2",
         "cifar10_fedavg_100",
+        "cifar10_fedavg_1000",
         "femnist_fedprox_500",
         "shakespeare_fedavg",
         "imagenet_silo_dp",

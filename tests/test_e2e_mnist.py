@@ -75,7 +75,8 @@ def test_cli_fit_then_evaluate_roundtrip(tmp_path, capsys):
 def test_cli_configs_lists_all(capsys):
     assert cli_main(["configs"]) == 0
     out = capsys.readouterr().out.split()
-    assert "cifar10_fedavg_100" in out and len(out) == 5
+    assert "cifar10_fedavg_100" in out and "cifar10_fedavg_1000" in out
+    assert len(out) == 6
 
 
 def test_eval_scan_parity(tmp_path):

@@ -200,3 +200,30 @@ def test_fedbuff_config_validation():
     cfg.server.compression = "qsgd"
     with pytest.raises(ValueError, match="compression"):
         cfg.validate()
+
+
+def test_fedbuff_durations_correlate_with_shard_size(tmp_path):
+    """VERDICT r2 weak-#4: the async workload model must couple client
+    train durations (and hence realized staleness) to data heterogeneity
+    — a big-data client trains longer than a tiny one."""
+    cfg = _fedbuff_cfg(tmp_path, s_max=4)
+    # heavy size heterogeneity: dirichlet at small alpha
+    cfg.data.partition = "dirichlet"
+    cfg.data.dirichlet_alpha = 0.2
+    exp = Experiment(cfg, echo=False)
+    work = np.minimum(exp.fed.client_sizes(), exp.shape.cap)
+    rng = np.random.default_rng(0)
+    # average simulated duration per client over many jitter draws
+    all_ids = np.arange(exp.fed.num_clients)
+    durs = np.mean(
+        [exp._client_durations(all_ids, rng) for _ in range(200)], axis=0
+    )
+    assert durs.min() >= 1 and durs.max() <= 4
+    # the biggest-shard client must average a strictly longer duration
+    # than the smallest-shard client, and rank correlation must be strong
+    big, small = int(np.argmax(work)), int(np.argmin(work))
+    assert durs[big] > durs[small]
+    rank_w = np.argsort(np.argsort(work))
+    rank_d = np.argsort(np.argsort(durs))
+    corr = np.corrcoef(rank_w, rank_d)[0, 1]
+    assert corr > 0.8, (corr, work, durs)

@@ -31,8 +31,8 @@ def test_mnist_real_npz(tmp_path):
     fed = build_federated_data(_data_cfg(tmp_path, "mnist", num_clients=2), seed=0)
     assert fed.meta["source"] == "real"
     assert fed.train_x.shape == (40, 28, 28, 1)
-    assert fed.train_x.dtype == np.float32
-    assert 0.0 <= fed.train_x.min() and fed.train_x.max() <= 1.0
+    # corpora stay RAW uint8 (normalized on device — trainer.normalize_input)
+    assert fed.train_x.dtype == np.uint8
     assert fed.test_x.shape == (10, 28, 28, 1)
     assert sum(len(ix) for ix in fed.client_indices) == 40
 
@@ -61,7 +61,7 @@ def test_cifar10_real_pickles(tmp_path):
     assert fed.meta["source"] == "real"
     assert fed.train_x.shape == (40, 32, 32, 3)  # 5 batches × 8, NHWC
     assert fed.test_x.shape == (6, 32, 32, 3)
-    assert fed.train_x.max() <= 1.0
+    assert fed.train_x.dtype == np.uint8  # raw bytes; normalized on device
     assert sum(len(ix) for ix in fed.client_indices) == 40
 
 
