@@ -132,6 +132,26 @@ class ServerConfig:
     # stragglers' partial updates still aggregate.
     straggler_rate: float = 0.0
     straggler_work: float = 0.5
+    # Secure aggregation — the masking core of Bonawitz et al. 2017,
+    # simulated faithfully at the arithmetic level: each participant's
+    # weighted delta is quantized to fixed-point int32 and additively
+    # masked with UNIFORM int32 ring masks m(slot) − m(next_participant)
+    # that cancel EXACTLY (mod 2^32) in the aggregate psum, so the
+    # server-visible per-client contribution is information-
+    # theoretically hidden while the aggregate is exact up to the
+    # quantization step. Dropout is handled by building the mask ring
+    # over the round's actual participants (known host-side before
+    # dispatch — the simulation's stand-in for the protocol's
+    # secret-sharing recovery). Scope: the key-agreement/secret-sharing
+    # layers of the real protocol are out of simulation scope, and the
+    # loss/example-count metrics still aggregate in plaintext (as
+    # published deployments also do for counts). Requires
+    # clip_delta_norm > 0 so |quantized values| are bounded:
+    # cohort · max_weight · clip / quant_step must stay < 2^31 (and
+    # per-client values < 2^24 for exact f32 rounding).
+    secure_aggregation: bool = False
+    # fixed-point quantization step for secure aggregation
+    secagg_quant_step: float = 1e-4
 
 
 @dataclass
@@ -164,6 +184,11 @@ class RunConfig:
     # iterations and cross-step fusion opportunities; lax.scan handles
     # non-dividing step counts itself. 1 = no unrolling.
     scan_unroll: int = 1
+    # Persistent XLA compilation cache directory ("" = off): round-program
+    # compiles (~40 s for ResNet, minutes for ViT-B+DP) are reused across
+    # processes/restarts — resume, retry-recovery, and repeated bench/CI
+    # invocations skip straight to execution.
+    compilation_cache_dir: str = ""
     # Failure recovery (SURVEY.md §5): on an unexpected error inside the
     # round loop, reload the latest checkpoint and continue, up to this
     # many times per fit() call. 0 = fail fast. Requires out_dir +
@@ -408,6 +433,38 @@ class ExperimentConfig:
                 f"server.clip_delta_norm must be >= 0, "
                 f"got {self.server.clip_delta_norm}"
             )
+        if self.server.secure_aggregation:
+            if self.server.aggregator != "weighted_mean":
+                # order statistics need raw per-client deltas — exactly
+                # what secure aggregation exists to hide
+                raise ValueError(
+                    "secure_aggregation is incompatible with robust "
+                    "aggregators (they need unmasked per-client deltas)"
+                )
+            if self.server.compression:
+                # masking produces dense uniform int32 — it IS the wire
+                # format; sparsity/quantization underneath is meaningless
+                raise ValueError(
+                    "secure_aggregation is incompatible with "
+                    "server.compression"
+                )
+            if self.algorithm not in ("fedavg", "fedprox"):
+                # scaffold/feddyn aggregate per-client state deltas in
+                # plaintext (would leak around the masking); fedbuff's
+                # buffer membership breaks the per-round participant ring
+                raise ValueError(
+                    "secure_aggregation supports fedavg/fedprox only"
+                )
+            if self.server.clip_delta_norm <= 0.0:
+                raise ValueError(
+                    "secure_aggregation requires clip_delta_norm > 0 "
+                    "(bounds the fixed-point range; see ServerConfig)"
+                )
+            if self.server.secagg_quant_step <= 0.0:
+                raise ValueError(
+                    f"secagg_quant_step must be > 0, "
+                    f"got {self.server.secagg_quant_step}"
+                )
         if not 0.0 <= self.server.straggler_rate <= 1.0:
             raise ValueError(
                 f"server.straggler_rate must be in [0, 1], "

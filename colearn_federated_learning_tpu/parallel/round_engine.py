@@ -96,13 +96,14 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
     return jax.tree.map(leaf, b_c, c_global, params, w_b)
 
 
-def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm):
-    """Engine-level mirror of config.validate()'s scaffold/topk pairing
-    rejections, SHARED by both engine factories so a direct
-    ``make_*_round_fn`` caller can't build an unsound combination that
-    the config layer would have refused (e.g. a scaffold+median engine
-    whose c_global update silently stays a plain poisonable mean).
-    FedDyn's equivalent guard lives in ``_feddyn_prepare``."""
+def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
+                         secagg=False, feddyn=False):
+    """Engine-level mirror of config.validate()'s pairing rejections,
+    SHARED by both engine factories so a direct ``make_*_round_fn``
+    caller can't build an unsound combination that the config layer
+    would have refused (e.g. a scaffold+median engine whose c_global
+    update silently stays a plain poisonable mean). FedDyn's
+    algorithm-specific guard lives in ``_feddyn_prepare``."""
     robust = aggregator != "weighted_mean"
     if scaffold and (robust or compression or clip_delta_norm > 0.0):
         # the c update (c += Σδc/N) has no robust equivalent and the
@@ -118,6 +119,69 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm):
         raise ValueError(
             "compression='topk' (sparse) breaks robust aggregation"
         )
+    if secagg:
+        if robust or scaffold or feddyn or compression:
+            # masking needs the plain weighted-mean path (see
+            # ServerConfig.secure_aggregation)
+            raise ValueError(
+                "secure aggregation requires the plain weighted-mean "
+                "path (no robust aggregator, stateful algorithm, or "
+                "compression)"
+            )
+        if clip_delta_norm <= 0.0:
+            # without a clip bound the fixed-point values are unbounded
+            # and quantized uploads can exceed int32 range, silently
+            # corrupting the mod-2^32 aggregate
+            raise ValueError(
+                "secure aggregation requires clip_delta_norm > 0"
+            )
+
+
+# fold constant deriving the secure-aggregation mask key from the round
+# rng — MUST be identical in both engines (mask parity is the parity)
+_SECAGG_FOLD = 0x5ECA66
+
+
+def _secagg_masks(mask_key, slot, template):
+    """Uniform int32 mask tree for one client ``slot`` (SecAgg core,
+    Bonawitz et al. 2017 §4 arithmetic): one threefry stream per
+    (slot, leaf), bitcast so all 32 bits survive (astype would clamp).
+    A client's wire mask is ``_secagg_masks(slot) − _secagg_masks(next)``
+    over int32 wraparound; summed over a ring of participants every
+    stream appears once with + and once with −, so the aggregate
+    cancellation is EXACT mod 2^32 — not float-approximate. Shared by
+    both engines."""
+    leaves, treedef = jax.tree.flatten(template)
+    ks = jax.random.fold_in(mask_key, slot)
+    out = []
+    for i, leaf in enumerate(leaves):
+        bits = jax.random.bits(
+            jax.random.fold_in(ks, i), leaf.shape, jnp.uint32
+        )
+        out.append(jax.lax.bitcast_convert_type(bits, jnp.int32))
+    return jax.tree.unflatten(treedef, out)
+
+
+def _secagg_upload(delta_b, b_w, b_slot, b_next, mask_key, params,
+                   quant_step: float):
+    """One block's masked uploads: quantize each client's WEIGHTED delta
+    to fixed-point int32 (exact for |q| < 2^24) and add the ring masks.
+    ``b_next == b_slot`` (dropped client) gives an exactly-zero mask and
+    a zero contribution (w = 0). Shared by both engines."""
+    contrib = jax.tree.map(
+        lambda dd: dd * b_w.astype(jnp.float32).reshape(
+            (dd.shape[0],) + (1,) * (dd.ndim - 1)
+        ),
+        delta_b,
+    )
+    q = jax.tree.map(
+        lambda c: jnp.round(c / quant_step).astype(jnp.int32), contrib
+    )
+    m_own = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_slot)
+    m_nxt = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_next)
+    return jax.tree.map(
+        lambda qq, a, b: qq + a - b, q, m_own, m_nxt
+    )
 
 
 def _feddyn_prepare(client_cfg, scaffold, feddyn_alpha, aggregator,
@@ -179,7 +243,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           clip_delta_norm: float = 0.0,
                           feddyn_alpha: float = 0.0,
                           byzantine_f: int = 0,
-                          scan_unroll: int = 1):
+                          scan_unroll: int = 1,
+                          secagg: bool = False,
+                          secagg_quant_step: float = 1e-4):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -241,7 +307,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     the server optimizer is bypassed — FedDyn defines its own update —
     but the round counter still advances for LR decay).
     """
-    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm)
+    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
+                         secagg=secagg, feddyn=feddyn_alpha > 0.0)
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -289,6 +356,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         rest = list(rest)
         lr_scale = rest.pop(0) if use_decay else None
         c_global, c_cohort = (rest.pop(0), rest.pop(0)) if stateful else (None, None)
+        if secagg:
+            slots_l, next_l, mask_key = rest.pop(0), rest.pop(0), rest.pop(0)
         params = _pcast_varying(params)
         if stateful:
             c_global = _pcast_varying(c_global)
@@ -308,7 +377,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     local_train, in_axes=(None, None, None, 0, 0, 0, None, 0),
                 )(params, train_x, train_y, b_idx, b_mask, b_keys, lr_scale, corr)
             else:
-                b_idx, b_mask, b_n, b_keys = inp  # leading axis: width
+                if secagg:  # leading axis: width
+                    b_idx, b_mask, b_n, b_keys, b_slot, b_next = inp
+                else:
+                    b_idx, b_mask, b_n, b_keys = inp
                 extra = () if lr_scale is None else (lr_scale,)
                 w_b, m_b = jax.vmap(
                     local_train,
@@ -335,6 +407,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # robust modes need every client's delta individually —
                 # emit the block's deltas instead of accumulating
                 ys["delta"] = delta_b
+            elif secagg:
+                # masked fixed-point uploads; the int32 accumulator's
+                # wraparound is the protocol's mod-2^32 arithmetic
+                upload_b = _secagg_upload(
+                    delta_b, b_w, b_slot, b_next, mask_key, params,
+                    secagg_quant_step,
+                )
+                d_acc = jax.tree.map(
+                    lambda a, u: a + u.sum(0), d_acc, upload_b
+                )
             else:
                 # Σ over the block of w_i·(Δ_i), fused as one contraction
                 d_acc = jax.tree.map(
@@ -373,6 +455,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
         n_blocks = idx.shape[0] // width
         scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if stateful else ())
+        if secagg:
+            scan_in += (slots_l, next_l)
         blocked = jax.tree.map(
             lambda a: a.reshape((n_blocks, width) + a.shape[1:]), scan_in
         )
@@ -384,8 +468,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             if stateful else jnp.zeros(())
         )
         # robust modes emit per-client deltas as scan ys instead of the
-        # weighted-sum accumulator — collapse that carry slot to a scalar
-        d0 = jnp.zeros(()) if robust else trees.tree_zeros_like(params)
+        # weighted-sum accumulator — collapse that carry slot to a scalar;
+        # secagg accumulates the masked fixed-point uploads in int32
+        if robust:
+            d0 = jnp.zeros(())
+        elif secagg:
+            d0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.int32), params)
+        else:
+            d0 = trees.tree_zeros_like(params)
         acc0 = _pcast_varying(
             (d0, jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), dc0),
         )
@@ -409,7 +499,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             out["deltas"] = unblock(ys["delta"])  # client-sharded stack
         else:
             d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
-            out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / denom)
+            if secagg:
+                # the cross-lane psum completed the mod-2^32 ring — masks
+                # are gone EXACTLY; dequantize back to the params dtype
+                out["mean_delta"] = jax.tree.map(
+                    lambda d, p: (
+                        d.astype(jnp.float32) * secagg_quant_step / denom
+                    ).astype(p.dtype),
+                    d_sum, params,
+                )
+            else:
+                out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / denom)
         if stateful:
             out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
             out["new_c"] = unblock(ys["c"])
@@ -425,6 +525,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         in_specs += (P(),)  # lr_scale scalar, replicated
     if stateful:
         in_specs += (P(), P(CLIENT_AXIS))  # c_global, c_cohort
+    if secagg:
+        # participant-ring slot/next (client-sharded) + replicated mask key
+        in_specs += (P(CLIENT_AXIS), P(CLIENT_AXIS), P())
     out_specs = {"n": P(), "loss": P()}
     if robust:
         out_specs["deltas"] = P(CLIENT_AXIS)
@@ -487,6 +590,29 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )
             return (new_params, new_opt_state, new_c_global, out["new_c"],
                     RoundMetrics(out["loss"], out["n"]))
+
+        return round_fn
+
+    if secagg:
+
+        @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
+                     n_ex, rng, slots, next_slots):
+            keys = jax.random.split(rng, idx.shape[0])
+            # the mask key is a pure function of the round rng — every
+            # lane (and the sequential oracle) derives the same streams
+            mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
+            extra = ()
+            if use_decay:
+                extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            out = sharded_lane(
+                params, train_x, train_y, idx, mask, n_ex, keys, *extra,
+                slots, next_slots, mask_key,
+            )
+            new_params, new_opt_state = server_update(
+                params, server_opt_state, out["mean_delta"]
+            )
+            return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
 
         return round_fn
 
@@ -668,7 +794,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              qsgd_levels: int = 256,
                              clip_delta_norm: float = 0.0,
                              feddyn_alpha: float = 0.0,
-                             byzantine_f: int = 0):
+                             byzantine_f: int = 0,
+                             secagg: bool = False,
+                             secagg_quant_step: float = 1e-4,
+                             scan_unroll: int = 1):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -676,7 +805,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     and ``aggregator`` mirror the sharded engine's signature exactly."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
-    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm)
+    _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
+                         secagg=secagg, feddyn=feddyn_alpha > 0.0)
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -691,13 +821,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
 
     compress = make_compressor(compression, topk_ratio, qsgd_levels)
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
-                                              local_dtype=local_dtype))
+                                              local_dtype=local_dtype,
+                                              scan_unroll=scan_unroll))
     update = jax.jit(server_update)
 
     use_decay = client_cfg.lr_decay != 1.0
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
-                 c_global=None, c_cohort=None):
+                 c_global=None, c_cohort=None, slots=None, next_slots=None):
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
         lr_scale = (
@@ -706,6 +837,16 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         )
         extra = (lr_scale,) if use_decay else ()
         deltas, weights, losses = [], [], []
+        if secagg:
+            # identical mask-key derivation + per-client streams as the
+            # sharded engine; int32 sums are order-independent mod 2^32,
+            # so the two engines agree BITWISE on the aggregate
+            mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
+            q_acc = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.int32), params
+            )
+            slots = jnp.asarray(slots, jnp.int32)
+            next_slots = jnp.asarray(next_slots, jnp.int32)
         new_cs = []
         dc_sum = (
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -763,10 +904,22 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 if compress is not None:
                     block = compress(block, keys[c][None])
                 delta_i = jax.tree.map(lambda a: a[0], block)
-            deltas.append(delta_i)
             n_c = jnp.asarray(n_ex[c])
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
             losses.append(m_i.loss)
+            if secagg:
+                # only the masked int32 accumulator survives the loop —
+                # keeping the raw f32 deltas too would retain cohort×
+                # params dead memory
+                up = _secagg_upload(
+                    jax.tree.map(lambda a: a[None], delta_i),
+                    jnp.asarray(weights[-1])[None],
+                    slots[c][None], next_slots[c][None],
+                    mask_key, params, secagg_quant_step,
+                )
+                q_acc = jax.tree.map(lambda a, u: a + u[0], q_acc, up)
+            else:
+                deltas.append(delta_i)
         n_total = jnp.asarray(n_ex).sum()
         w_sum = jnp.sum(jnp.stack(weights))
         denom = jnp.where(w_sum > 0, w_sum, 1.0)
@@ -779,6 +932,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             mean_delta = robust_reduce(
                 stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio,
                 byzantine_f,
+            )
+        elif secagg:
+            # the cohort sum completed the ring: masks cancelled exactly
+            mean_delta = jax.tree.map(
+                lambda d, p: (
+                    d.astype(jnp.float32) * secagg_quant_step / denom
+                ).astype(p.dtype),
+                q_acc, params,
             )
         else:
             # deltas accumulate in f32; the final cast mirrors the sharded

@@ -51,6 +51,13 @@ class Experiment:
         self.cfg = cfg
         if cfg.run.sanitize:
             jax.config.update("jax_debug_nans", True)
+        if cfg.run.compilation_cache_dir:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.expanduser(cfg.run.compilation_cache_dir),
+            )
+            # cache every round program, not just the slowest compiles
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
         compute_dtype = _DTYPES[cfg.run.compute_dtype]
         self.model = build_model(
             cfg.model.name, cfg.model.num_classes,
@@ -84,6 +91,34 @@ class Experiment:
         # trained against the stale params version it started from
         # (kept in an on-device history ring), staleness-decayed.
         self.fedbuff = cfg.algorithm == "fedbuff"
+        # secure aggregation (ServerConfig.secure_aggregation): the host
+        # supplies the per-round participant mask ring (slots/next)
+        self.secagg = cfg.server.secure_aggregation
+        if self.secagg:
+            # worst-case fixed-point range check (see ServerConfig): the
+            # clipped per-coordinate bound times the max FedAvg weight,
+            # summed over the cohort, must stay inside int32 — a wrap
+            # would silently corrupt the aggregate. Warn, don't raise:
+            # realized deltas are typically orders of magnitude below
+            # the clip bound.
+            max_w = (
+                1.0 if cfg.server.sampling == "weighted"
+                else float(self.shape.cap)
+            )
+            bound = (
+                cfg.server.cohort_size * max_w * cfg.server.clip_delta_norm
+                / cfg.server.secagg_quant_step
+            )
+            if bound >= 2**31:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "secure_aggregation worst-case fixed-point bound "
+                    "cohort*max_weight*clip/quant_step = %.3g >= 2^31; "
+                    "aggregates can wrap if clients actually reach the "
+                    "clip bound — consider a larger secagg_quant_step",
+                    bound,
+                )
         if self.fedbuff:
             # per-client base durations for the async workload model:
             # capped work (= the examples the client actually trains on)
@@ -158,6 +193,8 @@ class Experiment:
                     ),
                     byzantine_f=cfg.server.krum_byzantine,
                     scan_unroll=cfg.run.scan_unroll,
+                    secagg=self.secagg,
+                    secagg_quant_step=cfg.server.secagg_quant_step,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -179,6 +216,9 @@ class Experiment:
                     cfg.server.feddyn_alpha if self.feddyn else 0.0
                 ),
                 byzantine_f=cfg.server.krum_byzantine,
+                secagg=self.secagg,
+                secagg_quant_step=cfg.server.secagg_quant_step,
+                scan_unroll=cfg.run.scan_unroll,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -401,7 +441,24 @@ class Experiment:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
         mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng)
         slab = self._stream_slab(idx) if self._stream else None
-        return cohort, idx, mask, n_ex, slab
+        ring = self._secagg_ring(n_ex) if self.secagg else None
+        return cohort, idx, mask, n_ex, slab, ring
+
+    @staticmethod
+    def _secagg_ring(n_ex: np.ndarray):
+        """Participant mask ring for secure aggregation: participants
+        (n_ex > 0) point to the next participant in slot order (the
+        last wraps to the first); dropped clients point to THEMSELVES,
+        which makes their mask exactly zero. Known host-side before
+        dispatch — the simulation's stand-in for the real protocol's
+        secret-sharing dropout recovery."""
+        k = len(n_ex)
+        slots = np.arange(k, dtype=np.int32)
+        nxt = slots.copy()
+        parts = np.flatnonzero(n_ex > 0)
+        if parts.size:
+            nxt[parts] = np.roll(parts, -1)
+        return slots, nxt
 
     def _apply_failures(self, mask, n_ex, k, host_rng):
         """Straggler truncation + dropout zeroing — shared by the sync
@@ -434,9 +491,9 @@ class Experiment:
     def _round_inputs(self, round_idx: int):
         fut = self._prefetch.pop(round_idx, None)
         if fut is not None:
-            cohort, idx, mask, n_ex, slab = fut.result()
+            cohort, idx, mask, n_ex, slab, ring = fut.result()
         else:
-            cohort, idx, mask, n_ex, slab = self._host_inputs(round_idx)
+            cohort, idx, mask, n_ex, slab, ring = self._host_inputs(round_idx)
         if self._stream and self._host_executor is None:
             # slab gathering is the heavy host work in stream mode; build
             # round r+1's slab on a worker thread while the device runs r
@@ -458,7 +515,12 @@ class Experiment:
             idx = self._put(idx, self._cohort_sharding)
             mask = self._put(mask, self._cohort_sharding)
             n_ex = self._put(n_ex, self._client_sharding)
-        return cohort, idx, mask, n_ex, train_x, train_y
+            if ring is not None:
+                ring = tuple(
+                    self._put(jnp.asarray(r), self._client_sharding)
+                    for r in ring
+                )
+        return cohort, idx, mask, n_ex, train_x, train_y, ring
 
     def _stream_slab(self, idx: np.ndarray):
         """Gather this round's unique example rows into a fixed-shape slab
@@ -560,7 +622,7 @@ class Experiment:
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
-        cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
+        cohort, idx, mask, n_ex, train_x, train_y, ring = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         if self.stateful:
             c_cohort = jax.tree.map(
@@ -589,9 +651,14 @@ class Experiment:
                 "c_clients": state["c_clients"],
                 "_metrics": metrics,
             }
+        # keyword-passed: the sequential engine's signature has optional
+        # c_global/c_cohort slots before the secagg ring args
+        kw = (
+            {"slots": ring[0], "next_slots": ring[1]} if ring is not None else {}
+        )
         params, opt_state, metrics = self.round_fn(
             state["params"], state["server_opt_state"],
-            train_x, train_y, idx, mask, n_ex, rng,
+            train_x, train_y, idx, mask, n_ex, rng, **kw,
         )
         return {
             "params": params,
