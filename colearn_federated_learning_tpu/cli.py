@@ -65,6 +65,12 @@ def build_parser():
     ev = sub.add_parser("evaluate", help="evaluate latest (or --step) checkpoint")
     _add_common(ev)
     ev.add_argument("--step", type=int, default=None, help="checkpoint round to load")
+    ev.add_argument("--federated", action="store_true",
+                    help="also report the per-client accuracy distribution "
+                         "of the global model (fairness view: mean/median/"
+                         "p10/worst across clients)")
+    ev.add_argument("--federated-clients", type=int, default=64,
+                    help="max clients in the federated evaluation")
     ev.add_argument("--personalize", action="store_true",
                     help="also report per-client fine-tune-then-eval accuracy")
     ev.add_argument("--personalize-epochs", type=int, default=1,
@@ -73,6 +79,16 @@ def build_parser():
                     help="max clients evaluated (sampled deterministically)")
     ev.add_argument("--holdout-frac", type=float, default=0.2,
                     help="per-client held-out fraction for the local eval")
+
+    ex = sub.add_parser(
+        "export",
+        help="export a checkpoint's global model params to one flax "
+             "msgpack file (the deployment artifact)",
+    )
+    _add_common(ex)
+    ex.add_argument("--step", type=int, default=None, help="checkpoint round to load")
+    ex.add_argument("--output", required=True, metavar="PATH",
+                    help="output .msgpack path")
 
     sub.add_parser("configs", help="list named configs")
     return p
@@ -134,16 +150,27 @@ def main(argv=None):
         return 0
     if args.cmd == "evaluate":
         kwargs = {}
+        if args.federated:
+            kwargs["federated"] = True
+            kwargs["federated_clients"] = args.federated_clients
         if args.personalize:
-            kwargs = {
+            kwargs.update({
                 "personalize": True,
                 "epochs": args.personalize_epochs,
                 "max_clients": args.personalize_clients,
                 "holdout_frac": args.holdout_frac,
-            }
+            })
         try:
             out = exp.evaluate_checkpoint(step=args.step, **kwargs)
         except ValueError as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        print(json.dumps(out))
+        return 0
+    if args.cmd == "export":
+        try:
+            out = exp.export_checkpoint(args.output, step=args.step)
+        except (ValueError, FileNotFoundError) as e:
             print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
             return 2
         print(json.dumps(out))

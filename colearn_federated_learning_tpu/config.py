@@ -152,6 +152,30 @@ class ServerConfig:
     secure_aggregation: bool = False
     # fixed-point quantization step for secure aggregation
     secagg_quant_step: float = 1e-4
+    # Central CLIENT-level DP (DP-FedAvg, McMahan et al. 2018 "Learning
+    # Differentially Private Recurrent Language Models"): Gaussian noise
+    # with std z·S/K is added ONCE to the aggregated mean delta, where
+    # z is this multiplier, S = clip_delta_norm is the per-client L2
+    # sensitivity, and K = cohort_size is a FIXED PUBLIC denominator —
+    # enabling client DP forces UNIFORM aggregation weights and the
+    # fixed denominator, because a data-dependent denominator (realized
+    # example counts) is itself private and would invalidate the
+    # sensitivity analysis. Protects whole clients rather than single
+    # examples (dp.* is example-level local DP-SGD; both can be
+    # enabled). Requires clip_delta_norm > 0; composes with
+    # secure_aggregation (noise is server-side, post-unmask — the
+    # standard deployed stack). ε accounting: the sampled-Gaussian RDP
+    # accountant with q = cohort/num_clients per round, reported as
+    # dp_client_epsilon in the run log.
+    dp_client_noise_multiplier: float = 0.0
+    # Simulated downlink (server→client broadcast) compression: QSGD-
+    # style unbiased stochastic quantization of the global params each
+    # round — clients train FROM the quantized broadcast, deltas are
+    # taken against it, the aggregate applies to the server's exact
+    # params (ops/compression.py downlink_quantize). Pairs with the
+    # uplink `compression` knob for the full comm-constrained story.
+    downlink_compression: str = ""  # "" | qsgd
+    downlink_qsgd_levels: int = 256
 
 
 @dataclass
@@ -433,6 +457,56 @@ class ExperimentConfig:
                 f"server.clip_delta_norm must be >= 0, "
                 f"got {self.server.clip_delta_norm}"
             )
+        if self.server.downlink_compression not in ("", "qsgd"):
+            raise ValueError(
+                f"unknown server.downlink_compression "
+                f"{self.server.downlink_compression!r}"
+            )
+        if self.server.downlink_compression:
+            if self.server.downlink_qsgd_levels < 1:
+                raise ValueError(
+                    f"server.downlink_qsgd_levels must be >= 1, "
+                    f"got {self.server.downlink_qsgd_levels}"
+                )
+            if self.algorithm not in ("fedavg", "fedprox"):
+                # scaffold/feddyn's state recursions assume clients
+                # received the exact params their c/h corrections track;
+                # fedbuff's ring would need per-version quantization
+                raise ValueError(
+                    "downlink_compression supports fedavg/fedprox only"
+                )
+        if self.server.dp_client_noise_multiplier < 0.0:
+            raise ValueError(
+                f"server.dp_client_noise_multiplier must be >= 0, "
+                f"got {self.server.dp_client_noise_multiplier}"
+            )
+        if self.server.dp_client_noise_multiplier > 0.0:
+            if self.server.clip_delta_norm <= 0.0:
+                # the clip IS the sensitivity bound the noise is
+                # calibrated to — without it the guarantee is vacuous
+                raise ValueError(
+                    "client-level DP requires clip_delta_norm > 0"
+                )
+            if self.server.aggregator != "weighted_mean":
+                # the sensitivity analysis is for the weighted mean;
+                # order statistics change the mechanism entirely
+                raise ValueError(
+                    "client-level DP requires aggregator=weighted_mean"
+                )
+            if self.server.compression:
+                # qsgd's unbiased quantization can inflate a clipped
+                # delta's norm past the clip, breaking the sensitivity
+                # bound; keep the mechanism sound
+                raise ValueError(
+                    "client-level DP is incompatible with compression"
+                )
+            if self.algorithm not in ("fedavg", "fedprox"):
+                # stateful trajectories (scaffold/feddyn) would consume
+                # noisy aggregates in their c/h recursions; fedbuff's
+                # staleness breaks the per-round sampling analysis
+                raise ValueError(
+                    "client-level DP supports fedavg/fedprox only"
+                )
         if self.server.secure_aggregation:
             if self.server.aggregator != "weighted_mean":
                 # order statistics need raw per-client deltas — exactly

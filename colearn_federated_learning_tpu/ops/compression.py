@@ -85,3 +85,32 @@ def make_compressor(kind: str, topk_ratio: float = 0.01, qsgd_levels: int = 256)
 
         return qsgd
     raise ValueError(f"unknown compression kind {kind!r}")
+
+
+def downlink_quantize(params, key, levels: int):
+    """Simulated downlink (server→client) broadcast compression:
+    QSGD-style unbiased stochastic quantization of the GLOBAL params.
+    One shared dither stream per leaf — the broadcast is one message,
+    every client decodes the identical quantized weights (unlike the
+    uplink operators, which are per-client). The server's own copy
+    stays exact: clients train FROM the quantized weights, their deltas
+    are taken against those weights, and the aggregate applies to the
+    exact server params — the real comm-constrained system's shape.
+    """
+    if levels < 1:
+        raise ValueError(f"downlink levels must be >= 1, got {levels}")
+    leaves, treedef = jax.tree.flatten(params)
+    out = []
+    for i, p in enumerate(leaves):
+        flat = p.astype(jnp.float32).reshape(-1)
+        norm = jnp.linalg.norm(flat)
+        safe = jnp.maximum(norm, 1e-30)
+        scaled = jnp.abs(flat) / safe * levels
+        u = jax.random.uniform(
+            jax.random.fold_in(key, i), flat.shape, jnp.float32
+        )
+        q = jnp.floor(scaled + u)
+        out.append(
+            (jnp.sign(flat) * norm * q / levels).reshape(p.shape).astype(p.dtype)
+        )
+    return jax.tree.unflatten(treedef, out)

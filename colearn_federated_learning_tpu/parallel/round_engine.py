@@ -97,7 +97,8 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
 
 
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
-                         secagg=False, feddyn=False):
+                         secagg=False, feddyn=False, client_dp=0.0,
+                         downlink=""):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -135,11 +136,46 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
             raise ValueError(
                 "secure aggregation requires clip_delta_norm > 0"
             )
+    if client_dp > 0.0:
+        # mirror config.validate(): the sensitivity analysis holds for
+        # the clipped uniform mean with a fixed denominator only
+        # (ServerConfig.dp_client_noise_multiplier)
+        if robust or scaffold or feddyn or compression:
+            raise ValueError(
+                "client-level DP requires the plain weighted-mean path"
+            )
+        if clip_delta_norm <= 0.0:
+            raise ValueError("client-level DP requires clip_delta_norm > 0")
+    if downlink and (scaffold or feddyn):
+        # state recursions track exact params (config.validate mirror)
+        raise ValueError(
+            "downlink compression supports fedavg/fedprox only"
+        )
 
 
 # fold constant deriving the secure-aggregation mask key from the round
 # rng — MUST be identical in both engines (mask parity is the parity)
 _SECAGG_FOLD = 0x5ECA66
+# fold constant for the central client-level DP noise key (DP-FedAvg);
+# identical in both engines so parity tests cover the noisy path too
+_CLIENT_DP_FOLD = 0xD9FEDA
+# fold constant for the downlink broadcast-quantization dither
+_DOWNLINK_FOLD = 0xD0147
+
+
+def _client_dp_noise(dp_key, template, std):
+    """Central DP-FedAvg noise tree (McMahan et al. 2018): one Gaussian
+    per coordinate with traced std ``z·S/denom``, one threefry stream
+    per leaf, cast to the leaf dtype. Added ONCE to the aggregated mean
+    delta — never per client. Shared by both engines."""
+    leaves, treedef = jax.tree.flatten(template)
+    out = []
+    for i, leaf in enumerate(leaves):
+        n = jax.random.normal(
+            jax.random.fold_in(dp_key, i), leaf.shape, jnp.float32
+        )
+        out.append(leaf + (n * std).astype(leaf.dtype))
+    return jax.tree.unflatten(treedef, out)
 
 
 def _secagg_masks(mask_key, slot, template):
@@ -245,7 +281,11 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           byzantine_f: int = 0,
                           scan_unroll: int = 1,
                           secagg: bool = False,
-                          secagg_quant_step: float = 1e-4):
+                          secagg_quant_step: float = 1e-4,
+                          client_dp_noise: float = 0.0,
+                          client_dp_max_weight: float = 1.0,
+                          downlink: str = "",
+                          downlink_levels: int = 256):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -308,7 +348,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     but the round counter still advances for LR decay).
     """
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
-                         secagg=secagg, feddyn=feddyn_alpha > 0.0)
+                         secagg=secagg, feddyn=feddyn_alpha > 0.0,
+                         client_dp=client_dp_noise, downlink=downlink)
+    if client_dp_noise > 0.0 and agg != "uniform":
+        # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
+        raise ValueError(
+            "client-level DP requires uniform aggregation weights "
+            "(the driver selects them automatically)"
+        )
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -345,9 +392,20 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
     use_decay = client_cfg.lr_decay != 1.0
-    from colearn_federated_learning_tpu.ops.compression import make_compressor
+    from colearn_federated_learning_tpu.ops.compression import (
+        downlink_quantize,
+        make_compressor,
+    )
 
     compress = make_compressor(compression, topk_ratio, qsgd_levels)
+
+    def _bcast(params, rng):
+        """The weights clients actually receive this round."""
+        if not downlink:
+            return params
+        return downlink_quantize(
+            params, jax.random.fold_in(rng, _DOWNLINK_FOLD), downlink_levels
+        )
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
@@ -358,6 +416,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         c_global, c_cohort = (rest.pop(0), rest.pop(0)) if stateful else (None, None)
         if secagg:
             slots_l, next_l, mask_key = rest.pop(0), rest.pop(0), rest.pop(0)
+        dp_key = rest.pop(0) if client_dp_noise > 0.0 else None
         params = _pcast_varying(params)
         if stateful:
             c_global = _pcast_varying(c_global)
@@ -495,6 +554,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), t
         )
         out = {"n": n_sum, "loss": l_sum / denom}
+        # Under client-level DP the mean's denominator is the FIXED
+        # public cohort size, never the realized weight sum — a
+        # data-dependent denominator is itself private and would break
+        # the sensitivity analysis (dropout then attenuates the
+        # estimator instead of leaking through the divisor).
+        agg_denom = (
+            jnp.float32(cohort_size) if client_dp_noise > 0.0 else denom
+        )
         if robust:
             out["deltas"] = unblock(ys["delta"])  # client-sharded stack
         else:
@@ -504,12 +571,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # are gone EXACTLY; dequantize back to the params dtype
                 out["mean_delta"] = jax.tree.map(
                     lambda d, p: (
-                        d.astype(jnp.float32) * secagg_quant_step / denom
+                        d.astype(jnp.float32) * secagg_quant_step / agg_denom
                     ).astype(p.dtype),
                     d_sum, params,
                 )
             else:
-                out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / denom)
+                out["mean_delta"] = trees.tree_scale(d_sum, 1.0 / agg_denom)
+            if dp_key is not None:
+                # central DP-FedAvg noise: std = z·S/K with per-client
+                # sensitivity S = clip (uniform weights enforced) and
+                # fixed K; every lane derives the identical streams, so
+                # the replicated aggregate stays replicated
+                std = (
+                    jnp.float32(
+                        client_dp_noise * client_dp_max_weight
+                        * clip_delta_norm
+                    ) / agg_denom
+                )
+                out["mean_delta"] = _client_dp_noise(
+                    dp_key, out["mean_delta"], std
+                )
         if stateful:
             out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
             out["new_c"] = unblock(ys["c"])
@@ -528,6 +609,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if secagg:
         # participant-ring slot/next (client-sharded) + replicated mask key
         in_specs += (P(CLIENT_AXIS), P(CLIENT_AXIS), P())
+    if client_dp_noise > 0.0:
+        in_specs += (P(),)  # central DP noise key, replicated
     out_specs = {"n": P(), "loss": P()}
     if robust:
         out_specs["deltas"] = P(CLIENT_AXIS)
@@ -605,9 +688,13 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            tail = (
+                (jax.random.fold_in(rng, _CLIENT_DP_FOLD),)
+                if client_dp_noise > 0.0 else ()
+            )
             out = sharded_lane(
-                params, train_x, train_y, idx, mask, n_ex, keys, *extra,
-                slots, next_slots, mask_key,
+                _bcast(params, rng), train_x, train_y, idx, mask, n_ex,
+                keys, *extra, slots, next_slots, mask_key, *tail,
             )
             new_params, new_opt_state = server_update(
                 params, server_opt_state, out["mean_delta"]
@@ -624,8 +711,13 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             # round-indexed client LR decay, derived inside the program
             # from the server state's round counter (aggregation.py)
             extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+        tail = (
+            (jax.random.fold_in(rng, _CLIENT_DP_FOLD),)
+            if client_dp_noise > 0.0 else ()
+        )
         out = sharded_lane(
-            params, train_x, train_y, idx, mask, n_ex, keys, *extra
+            _bcast(params, rng), train_x, train_y, idx, mask, n_ex, keys,
+            *extra, *tail,
         )
         new_params, new_opt_state = server_update(
             params, server_opt_state, _mean_delta(out, n_ex)
@@ -797,7 +889,11 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              byzantine_f: int = 0,
                              secagg: bool = False,
                              secagg_quant_step: float = 1e-4,
-                             scan_unroll: int = 1):
+                             scan_unroll: int = 1,
+                             client_dp_noise: float = 0.0,
+                             client_dp_max_weight: float = 1.0,
+                             downlink: str = "",
+                             downlink_levels: int = 256):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -806,7 +902,13 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
-                         secagg=secagg, feddyn=feddyn_alpha > 0.0)
+                         secagg=secagg, feddyn=feddyn_alpha > 0.0,
+                         client_dp=client_dp_noise, downlink=downlink)
+    if client_dp_noise > 0.0 and agg != "uniform":
+        raise ValueError(
+            "client-level DP requires uniform aggregation weights "
+            "(the driver selects them automatically)"
+        )
     feddyn, client_cfg = _feddyn_prepare(
         client_cfg, scaffold, feddyn_alpha, aggregator, compression,
         clip_delta_norm,
@@ -817,7 +919,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     if aggregator not in ("weighted_mean", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
-    from colearn_federated_learning_tpu.ops.compression import make_compressor
+    from colearn_federated_learning_tpu.ops.compression import (
+        downlink_quantize,
+        make_compressor,
+    )
 
     compress = make_compressor(compression, topk_ratio, qsgd_levels)
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
@@ -837,6 +942,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         )
         extra = (lr_scale,) if use_decay else ()
         deltas, weights, losses = [], [], []
+        # the weights clients receive this round (identical dither
+        # derivation as the sharded engine — parity holds)
+        bcast = params
+        if downlink:
+            bcast = downlink_quantize(
+                params, jax.random.fold_in(rng, _DOWNLINK_FOLD),
+                downlink_levels,
+            )
         if secagg:
             # identical mask-key derivation + per-client streams as the
             # sharded engine; int32 sums are order-independent mod 2^32,
@@ -889,11 +1002,13 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                     lambda a, nc, ci: a + (nc - ci), dc_sum, new_c, c_i
                 )
             else:
-                w_i, m_i = local_train(params, train_x, train_y, idx[c], mask[c],
+                w_i, m_i = local_train(bcast, train_x, train_y, idx[c], mask[c],
                                        keys[c], *extra)
+            # delta vs the RECEIVED weights (bcast == params unless
+            # downlink compression is on), applied to the exact params
             delta_i = jax.tree.map(
                 lambda w, p: w.astype(jnp.float32) - p.astype(jnp.float32),
-                w_i, params,
+                w_i, bcast,
             )
             if clip_delta_norm > 0.0 or compress is not None:
                 # one width-1 block through the SAME operators as the
@@ -923,6 +1038,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         n_total = jnp.asarray(n_ex).sum()
         w_sum = jnp.sum(jnp.stack(weights))
         denom = jnp.where(w_sum > 0, w_sum, 1.0)
+        # fixed public denominator under client DP (see the sharded lane)
+        agg_denom = jnp.float32(k) if client_dp_noise > 0.0 else denom
         if robust:
             from colearn_federated_learning_tpu.server.aggregation import (
                 robust_reduce,
@@ -937,7 +1054,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             # the cohort sum completed the ring: masks cancelled exactly
             mean_delta = jax.tree.map(
                 lambda d, p: (
-                    d.astype(jnp.float32) * secagg_quant_step / denom
+                    d.astype(jnp.float32) * secagg_quant_step / agg_denom
                 ).astype(p.dtype),
                 q_acc, params,
             )
@@ -951,7 +1068,16 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 acc = trees.tree_axpy(w, d, acc)
             mean_delta = jax.tree.map(
                 lambda d, p: d.astype(p.dtype),
-                trees.tree_scale(acc, 1.0 / denom), params,
+                trees.tree_scale(acc, 1.0 / agg_denom), params,
+            )
+        if client_dp_noise > 0.0:
+            # same key derivation + per-leaf streams as the sharded
+            # engine — parity holds on the noisy path too
+            std = jnp.float32(
+                client_dp_noise * client_dp_max_weight * clip_delta_norm
+            ) / agg_denom
+            mean_delta = _client_dp_noise(
+                jax.random.fold_in(rng, _CLIENT_DP_FOLD), mean_delta, std
             )
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
         if stateful:

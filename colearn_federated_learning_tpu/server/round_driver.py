@@ -140,6 +140,11 @@ class Experiment:
         agg = "uniform" if cfg.server.sampling == "weighted" else "examples"
         if self.feddyn:
             agg = "uniform"  # the paper's plain mean over the cohort
+        if cfg.server.dp_client_noise_multiplier > 0.0:
+            # client-level DP needs w_i ∈ {0,1} and a fixed public
+            # denominator — example weights are private data and would
+            # invalidate the sensitivity analysis (ServerConfig docs)
+            agg = "uniform"
         self._agg_mode = agg
 
         if cfg.run.engine == "sharded":
@@ -195,6 +200,10 @@ class Experiment:
                     scan_unroll=cfg.run.scan_unroll,
                     secagg=self.secagg,
                     secagg_quant_step=cfg.server.secagg_quant_step,
+                    client_dp_noise=cfg.server.dp_client_noise_multiplier,
+                    client_dp_max_weight=self._client_dp_max_weight(),
+                    downlink=cfg.server.downlink_compression,
+                    downlink_levels=cfg.server.downlink_qsgd_levels,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -219,6 +228,10 @@ class Experiment:
                 secagg=self.secagg,
                 secagg_quant_step=cfg.server.secagg_quant_step,
                 scan_unroll=cfg.run.scan_unroll,
+                client_dp_noise=cfg.server.dp_client_noise_multiplier,
+                client_dp_max_weight=self._client_dp_max_weight(),
+                downlink=cfg.server.downlink_compression,
+                downlink_levels=cfg.server.downlink_qsgd_levels,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -320,6 +333,12 @@ class Experiment:
     def _local_dtype(self):
         d = self.cfg.run.local_param_dtype
         return _DTYPES[d] if d else None
+
+    def _client_dp_max_weight(self) -> float:
+        """Per-client max aggregation weight for the DP-FedAvg
+        sensitivity bound — always 1: client DP forces uniform
+        aggregation weights (see __init__)."""
+        return 1.0
 
     def _put(self, arr, sharding):
         if sharding is None:
@@ -816,6 +835,10 @@ class Experiment:
                 }
                 if cfg.dp.enabled:
                     record["dp_epsilon"] = round(self.dp_epsilon(ridx + 1), 4)
+                if cfg.server.dp_client_noise_multiplier > 0.0:
+                    record["dp_client_epsilon"] = round(
+                        self.dp_client_epsilon(ridx + 1), 4
+                    )
                 if ridx in self._async_stats:
                     record["mean_staleness"] = round(
                         self._async_stats.pop(ridx), 3
@@ -885,10 +908,83 @@ class Experiment:
             self.cfg.dp.noise_multiplier, q, total_steps, self.cfg.dp.delta
         )
 
+    def dp_client_epsilon(self, rounds_done: int) -> float:
+        """Client-level (ε, δ) spent by central DP-FedAvg noise: the
+        sampled-Gaussian RDP accountant (same closed form as the
+        example-level accountant) composed over rounds with client
+        sampling rate q = cohort/num_clients; δ from cfg.dp.delta.
+        Upper bound under uniform sampling (size-weighted sampling
+        raises a big client's q — config pairs weighted sampling with
+        uniform weights, and the reported q uses the uniform rate)."""
+        from colearn_federated_learning_tpu.privacy.dp import rdp_epsilon
+
+        q = min(1.0, self.cfg.server.cohort_size / self.fed.num_clients)
+        return rdp_epsilon(
+            self.cfg.server.dp_client_noise_multiplier, q, rounds_done,
+            self.cfg.dp.delta,
+        )
+
     def evaluate(self, params) -> Dict[str, float]:
         xb, yb, mb = self._eval_data
         loss, acc, n = jax.device_get(self._eval_all(params, xb, yb, mb))
         return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
+
+    def evaluate_federated(self, params, max_clients: int = 64,
+                           seed: Optional[int] = None) -> Dict[str, float]:
+        """Federated (per-client) evaluation of the GLOBAL model: run the
+        model on each client's OWN shard and report the accuracy
+        distribution across clients — the fairness view centralized eval
+        averages away (a model can hold 90% central accuracy while its
+        worst-decile clients sit near chance under label skew).
+
+        Simulation caveat, stated rather than hidden: clients have no
+        separate local test split (the reference's datasets don't ship
+        one), so this evaluates on each client's local data — the
+        standard simulator proxy for federated evaluation; it measures
+        the global model's FIT to each client's distribution, not
+        held-out generalization (``evaluate`` does that centrally,
+        ``evaluate_personalized`` does per-client holdouts).
+
+        Deterministic in ``seed`` (client subsample when
+        num_clients > max_clients). Reports mean/std/median, the 10th
+        percentile, and the worst client."""
+        if max_clients < 1:
+            raise ValueError(f"max_clients must be >= 1, got {max_clients}")
+        seed = self.cfg.run.seed if seed is None else seed
+        rng = np.random.default_rng((seed, 60013))
+        eligible = [
+            cid for cid in range(self.fed.num_clients)
+            if len(self.fed.client_indices[cid]) >= 1
+        ]
+        if len(eligible) > max_clients:
+            eligible = sorted(
+                rng.choice(eligible, size=max_clients, replace=False)
+            )
+        batch = self.cfg.client.batch_size
+        accs = []
+        for cid in eligible:
+            ids = np.asarray(self.fed.client_indices[cid])
+            xb, yb, mb = eval_batches(
+                self.fed.train_x[ids], self.fed.train_y[ids], batch
+            )
+            c_sum = n_sum = 0.0
+            for b in range(xb.shape[0]):
+                _, c, m = self._eval_fn(
+                    params, jnp.asarray(xb[b]), jnp.asarray(yb[b]),
+                    jnp.asarray(mb[b]),
+                )
+                c_sum += float(c)
+                n_sum += float(m)
+            accs.append(c_sum / max(n_sum, 1.0))
+        a = np.asarray(accs)
+        return {
+            "federated_acc_mean": float(a.mean()),
+            "federated_acc_std": float(a.std()),
+            "federated_acc_median": float(np.median(a)),
+            "federated_acc_p10": float(np.percentile(a, 10)),
+            "federated_acc_worst": float(a.min()),
+            "federated_clients": len(accs),
+        }
 
     def evaluate_personalized(self, params, epochs: int = 1,
                               holdout_frac: float = 0.2,
@@ -1007,8 +1103,27 @@ class Experiment:
             "personalize_epochs": epochs,
         }
 
+    def export_checkpoint(self, path: str, step: Optional[int] = None) -> Dict[str, Any]:
+        """Export a checkpoint's GLOBAL MODEL PARAMS to a single flax
+        msgpack file (`colearn export`) — the deployment artifact; see
+        utils/checkpoint.export_params / load_params for the consumer
+        side."""
+        from colearn_federated_learning_tpu.utils.checkpoint import export_params
+
+        store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
+        state, step = store.restore(step=step, template=self.init_state())
+        store.close()
+        out_path = export_params(state["params"], path)
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree.leaves(state["params"])
+        )
+        return {"event": "exported", "path": out_path, "round": int(state["round"]),
+                "num_params": n_params}
+
     def evaluate_checkpoint(self, step: Optional[int] = None,
                             personalize: bool = False,
+                            federated: bool = False,
+                            federated_clients: int = 64,
                             **personalize_kwargs) -> Dict[str, float]:
         store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
         template = self.init_state()
@@ -1016,6 +1131,12 @@ class Experiment:
         store.close()
         state = self._place_state(state)
         out = self.evaluate(state["params"])
+        if federated:
+            out.update(
+                self.evaluate_federated(
+                    state["params"], max_clients=federated_clients,
+                )
+            )
         if personalize:
             out.update(
                 self.evaluate_personalized(

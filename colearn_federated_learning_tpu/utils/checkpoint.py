@@ -86,3 +86,32 @@ class CheckpointStore:
         # joins in-flight async saves before releasing the manager
         self._mngr.wait_until_finished()
         self._mngr.close()
+
+
+def export_params(params, path: str) -> str:
+    """Serialize a params pytree to a single self-contained flax
+    msgpack file — the deployment artifact (the torch-world equivalent
+    of exporting a ``state_dict``): no orbax directory structure, no
+    optimizer/round state, loadable anywhere flax is installed via
+    :func:`load_params` (or ``flax.serialization.msgpack_restore``).
+    """
+    from flax import serialization
+
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+    return path
+
+
+def load_params(path: str, template=None):
+    """Load an :func:`export_params` artifact. With ``template`` the
+    result keeps the template's exact pytree/dtype structure; without
+    it, the raw msgpack dict-of-arrays is returned."""
+    from flax import serialization
+
+    with open(os.path.expanduser(path), "rb") as f:
+        data = f.read()
+    if template is not None:
+        return serialization.from_bytes(template, data)
+    return serialization.msgpack_restore(data)

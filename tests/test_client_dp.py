@@ -1,0 +1,174 @@
+"""Central client-level DP — DP-FedAvg (McMahan et al. 2018):
+``server.dp_client_noise_multiplier`` adds calibrated Gaussian noise
+ONCE to the aggregated mean delta, with sensitivity bounded by
+``max_weight · clip_delta_norm``. Pinned here: z=0 reduces exactly to
+the plain path, noise magnitude matches the calibration, engine parity
+(same rng ⇒ same noise), composition with secure aggregation, ε
+accounting monotonicity, config guards, and e2e convergence under
+small noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import DPConfig, get_named_config
+from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh
+from colearn_federated_learning_tpu.parallel.round_engine import (
+    make_sequential_round_fn,
+    make_sharded_round_fn,
+)
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+from tests.test_secagg import _setup
+
+
+def test_zero_noise_is_exactly_plain():
+    (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
+     n_ex, slots, nxt) = _setup()
+    mk = lambda **kw: make_sequential_round_fn(  # noqa: E731
+        model, ccfg, DPConfig(), "classify", server_update,
+        clip_delta_norm=10.0, **kw,
+    )
+    rng = jax.random.PRNGKey(5)
+    p0, _, _ = mk()(params, server_init(params), tx, ty, idx, mask, n_ex, rng)
+    p1, _, _ = mk(client_dp_noise=0.0)(
+        params, server_init(params), tx, ty, idx, mask, n_ex, rng
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        p0, p1,
+    )
+
+
+def test_noise_magnitude_matches_calibration():
+    """params_noisy − params_plain is exactly the server-applied noise
+    (server_lr=1, optimizer=mean): its empirical std must match the
+    fixed-denominator calibration z·clip/K — never the realized
+    (private) weight sum."""
+    (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
+     n_ex, slots, nxt) = _setup()
+    z, clip = 2.0, 10.0
+    rng = jax.random.PRNGKey(9)
+    plain = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        clip_delta_norm=clip, agg="uniform",
+    )
+    noisy = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update,
+        clip_delta_norm=clip, client_dp_noise=z, agg="uniform",
+    )
+    p0, _, _ = plain(params, server_init(params), tx, ty, idx, mask, n_ex, rng)
+    p1, _, _ = noisy(params, server_init(params), tx, ty, idx, mask, n_ex, rng)
+    diff = np.concatenate([
+        (np.asarray(a) - np.asarray(b)).ravel()
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p0))
+    ])
+    k = int(np.asarray(idx).shape[0])  # fixed public cohort size
+    expect = z * clip / k
+    # plain uses denom = Σw = K here (full participation), so the only
+    # difference is the noise itself
+    assert diff.std() == pytest.approx(expect, rel=0.05), (diff.std(), expect)
+    assert abs(diff.mean()) < 3 * expect / np.sqrt(diff.size)
+
+
+def test_client_dp_rejects_example_weighting():
+    """The fixed-denominator analysis needs w ∈ {0,1}: building an
+    engine with client DP + example weights must fail loudly."""
+    (model, params, ccfg, server_init, server_update, *_rest) = _setup()
+    with pytest.raises(ValueError, match="uniform"):
+        make_sequential_round_fn(
+            model, ccfg, DPConfig(), "classify", server_update,
+            clip_delta_norm=1.0, client_dp_noise=1.0, agg="examples",
+        )
+
+
+@pytest.mark.parametrize("with_secagg", [False, True])
+def test_client_dp_sharded_matches_sequential(with_secagg):
+    """Same rng ⇒ same noise streams in both engines; with secagg the
+    noise rides on top of the exactly-unmasked aggregate."""
+    (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
+     n_ex, slots, nxt) = _setup()
+    kw = dict(clip_delta_norm=10.0, client_dp_noise=0.7, agg="uniform")
+    if with_secagg:
+        kw.update(secagg=True, secagg_quant_step=1e-4)
+    mesh = build_client_mesh(8)
+    sharded = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=8, donate=False, **kw,
+    )
+    seq = make_sequential_round_fn(
+        model, ccfg, DPConfig(), "classify", server_update, **kw,
+    )
+    rng = jax.random.PRNGKey(13)
+    args = (params, server_init(params), tx, ty, idx, mask, n_ex, rng)
+    if with_secagg:
+        p_sh, _, _ = sharded(*args, slots, nxt)
+        p_sq, _, _ = seq(*args, slots=slots, next_slots=nxt)
+        atol = 5e-6  # quantization-bucket flips (see test_secagg)
+    else:
+        p_sh, _, _ = sharded(*args)
+        p_sq, _, _ = seq(*args)
+        atol = 1e-6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=atol
+        ),
+        p_sh, p_sq,
+    )
+
+
+def test_client_dp_epsilon_accounting(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 16
+    cfg.server.cohort_size = 4
+    cfg.server.dp_client_noise_multiplier = 1.2
+    cfg.server.clip_delta_norm = 1.0
+    cfg.data.synthetic_train_size = 512
+    cfg.run.out_dir = str(tmp_path)
+    exp = Experiment(cfg.validate(), echo=False)
+    e1, e10, e100 = (exp.dp_client_epsilon(r) for r in (1, 10, 100))
+    assert 0 < e1 < e10 < e100 < float("inf")
+
+
+def test_client_dp_config_guards():
+    base = get_named_config("mnist_fedavg_2")
+    base.server.dp_client_noise_multiplier = 1.0
+    with pytest.raises(ValueError, match="clip_delta_norm"):
+        base.validate()
+    base.server.clip_delta_norm = 1.0
+    base.validate()  # ok
+    for field, value in [("aggregator", "median"), ("compression", "qsgd")]:
+        bad = get_named_config("mnist_fedavg_2")
+        bad.server.dp_client_noise_multiplier = 1.0
+        bad.server.clip_delta_norm = 1.0
+        setattr(bad.server, field, value)
+        with pytest.raises(ValueError):
+            bad.validate()
+    bad = get_named_config("mnist_fedavg_2")
+    bad.algorithm = "fedbuff"
+    bad.server.dp_client_noise_multiplier = 1.0
+    bad.server.clip_delta_norm = 1.0
+    with pytest.raises(ValueError):
+        bad.validate()
+
+
+def test_client_dp_e2e_converges_and_logs_epsilon(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    # mild regime so the smoke still learns: uniform weights forced,
+    # fixed K = 2 ⇒ noise std = z·clip/K = 0.01/coordinate/round
+    cfg.server.dp_client_noise_multiplier = 0.02
+    cfg.server.clip_delta_norm = 1.0
+    cfg.server.num_rounds = 6
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.metrics_flush_every = 1
+    cfg.data.synthetic_train_size = 512
+    cfg.data.synthetic_test_size = 256
+    exp = Experiment(cfg.validate(), echo=False)
+    state = exp.fit()
+    metrics = exp.evaluate(state["params"])
+    assert metrics["eval_acc"] > 0.9, metrics
+    eps = [r["dp_client_epsilon"] for r in exp.logger.history
+           if "dp_client_epsilon" in r]
+    assert eps and eps == sorted(eps) and eps[0] > 0

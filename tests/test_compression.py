@@ -158,3 +158,108 @@ def test_compression_config_validation():
     cfg.server.aggregator = "median"
     with pytest.raises(ValueError, match="sparse"):
         cfg.validate()
+
+
+class TestDownlink:
+    """Downlink broadcast quantization (ops/compression.downlink_quantize
+    + server.downlink_compression)."""
+
+    def test_unbiased_and_norm_preserving_shape(self):
+        import jax
+
+        key = jax.random.PRNGKey(0)
+        p = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)),
+                              jnp.float32)}
+        from colearn_federated_learning_tpu.ops.compression import (
+            downlink_quantize,
+        )
+
+        # unbiasedness: average over many dither draws ≈ the original
+        acc = jnp.zeros_like(p["w"])
+        n = 200
+        for i in range(n):
+            acc = acc + downlink_quantize(
+                p, jax.random.fold_in(key, i), levels=8
+            )["w"]
+        err = np.abs(np.asarray(acc / n - p["w"])).mean()
+        # dither std per coord ≈ ‖p‖/levels; mean-of-200 shrinks by √200
+        bound = 3 * float(jnp.linalg.norm(p["w"])) / 8 / np.sqrt(n)
+        assert err < bound, (err, bound)
+        # identical key ⇒ identical broadcast (it is ONE message)
+        a = downlink_quantize(p, key, levels=8)["w"]
+        b = downlink_quantize(p, key, levels=8)["w"]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_engine_parity_with_downlink(self):
+        import jax
+
+        from colearn_federated_learning_tpu.config import (
+            DPConfig,
+        )
+        from colearn_federated_learning_tpu.parallel.mesh import (
+            build_client_mesh,
+        )
+        from colearn_federated_learning_tpu.parallel.round_engine import (
+            make_sequential_round_fn,
+            make_sharded_round_fn,
+        )
+        from tests.test_secagg import _setup
+
+        (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
+         n_ex, slots, nxt) = _setup()
+        kw = dict(downlink="qsgd", downlink_levels=64)
+        mesh = build_client_mesh(8)
+        sharded = make_sharded_round_fn(
+            model, ccfg, DPConfig(), "classify", mesh, server_update,
+            cohort_size=8, donate=False, **kw,
+        )
+        seq = make_sequential_round_fn(
+            model, ccfg, DPConfig(), "classify", server_update, **kw,
+        )
+        rng = jax.random.PRNGKey(21)
+        p_sh, _, m_sh = sharded(
+            params, server_init(params), tx, ty, idx, mask, n_ex, rng
+        )
+        p_sq, _, m_sq = seq(
+            params, server_init(params), tx, ty, idx, mask, n_ex, rng
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-6
+            ),
+            p_sh, p_sq,
+        )
+        np.testing.assert_allclose(
+            float(m_sh.train_loss), float(m_sq.train_loss), rtol=1e-5
+        )
+
+    def test_e2e_converges_under_downlink_compression(self, tmp_path):
+        from colearn_federated_learning_tpu.config import get_named_config
+        from colearn_federated_learning_tpu.server.round_driver import (
+            Experiment,
+        )
+
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.server.downlink_compression = "qsgd"
+        cfg.server.downlink_qsgd_levels = 256
+        cfg.server.num_rounds = 6
+        cfg.server.eval_every = 0
+        cfg.run.out_dir = str(tmp_path)
+        cfg.data.synthetic_train_size = 512
+        cfg.data.synthetic_test_size = 256
+        exp = Experiment(cfg.validate(), echo=False)
+        state = exp.fit()
+        metrics = exp.evaluate(state["params"])
+        assert metrics["eval_acc"] > 0.9, metrics
+
+    def test_validation_rejects_stateful(self):
+        import pytest as _pytest
+
+        from colearn_federated_learning_tpu.config import get_named_config
+
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.algorithm = "scaffold"
+        cfg.client.momentum = 0.0
+        cfg.server.downlink_compression = "qsgd"
+        with _pytest.raises(ValueError):
+            cfg.validate()

@@ -87,3 +87,51 @@ def test_cli_evaluate_personalize(tmp_path, capsys):
               "personalized_clients", "eval_acc"):
         assert k in out, out
     assert out["personalized_clients"] == 2
+
+
+def test_federated_eval_reports_fairness_distribution(tmp_path):
+    """evaluate_federated: per-client accuracy distribution of the
+    global model — under Dirichlet label skew the percentile spread is
+    real (worst ≤ p10 ≤ median), stats are internally consistent, and
+    the client subsample is deterministic in seed."""
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 12
+    cfg.data.partition = "dirichlet"
+    cfg.data.dirichlet_alpha = 0.3
+    cfg.server.cohort_size = 4
+    cfg.server.num_rounds = 4
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = str(tmp_path)
+    cfg.data.synthetic_train_size = 512
+    cfg.data.synthetic_test_size = 128
+    exp = Experiment(cfg.validate(), echo=False)
+    state = exp.fit()
+    out = exp.evaluate_federated(state["params"], max_clients=8)
+    assert out["federated_clients"] == 8
+    assert (0.0 <= out["federated_acc_worst"] <= out["federated_acc_p10"]
+            <= out["federated_acc_median"] <= 1.0)
+    assert 0.0 <= out["federated_acc_mean"] <= 1.0
+    # deterministic in seed
+    again = exp.evaluate_federated(state["params"], max_clients=8)
+    assert out == again
+    other = exp.evaluate_federated(state["params"], max_clients=8, seed=99)
+    assert out["federated_clients"] == other["federated_clients"]
+
+
+def test_cli_evaluate_federated(tmp_path, capsys):
+    from colearn_federated_learning_tpu.cli import main as cli_main
+
+    common = [
+        "--config", "mnist_fedavg_2", "--out-dir", str(tmp_path),
+        "--set", "data.synthetic_train_size=256",
+        "--set", "data.synthetic_test_size=64",
+    ]
+    assert cli_main(["fit", *common, "--set", "server.num_rounds=2",
+                     "--set", "server.eval_every=0"]) == 0
+    capsys.readouterr()
+    assert cli_main(["evaluate", *common, "--federated",
+                     "--federated-clients", "2"]) == 0
+    import json as _json
+
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "federated_acc_mean" in out and out["federated_clients"] == 2
