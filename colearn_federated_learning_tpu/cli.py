@@ -162,7 +162,7 @@ def main(argv=None):
             })
         try:
             out = exp.evaluate_checkpoint(step=args.step, **kwargs)
-        except ValueError as e:
+        except (ValueError, FileNotFoundError) as e:
             print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
             return 2
         print(json.dumps(out))

@@ -507,6 +507,16 @@ class ExperimentConfig:
                 raise ValueError(
                     "client-level DP supports fedavg/fedprox only"
                 )
+            if self.server.sampling == "weighted":
+                # size-proportional sampling raises a big client's
+                # per-round inclusion probability above cohort/N, so the
+                # accountant's q would understate that client's true
+                # RDP spend — the logged ε must be an upper bound for
+                # EVERY client (privacy/dp.py contract)
+                raise ValueError(
+                    "client-level DP requires server.sampling='uniform' "
+                    "(weighted sampling breaks the q = cohort/N bound)"
+                )
         if self.server.secure_aggregation:
             if self.server.aggregator != "weighted_mean":
                 # order statistics need raw per-client deltas — exactly

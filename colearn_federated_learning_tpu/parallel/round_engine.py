@@ -283,7 +283,6 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           secagg: bool = False,
                           secagg_quant_step: float = 1e-4,
                           client_dp_noise: float = 0.0,
-                          client_dp_max_weight: float = 1.0,
                           downlink: str = "",
                           downlink_levels: int = 256):
     """Build the jitted one-program round function.
@@ -583,10 +582,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # fixed K; every lane derives the identical streams, so
                 # the replicated aggregate stays replicated
                 std = (
-                    jnp.float32(
-                        client_dp_noise * client_dp_max_weight
-                        * clip_delta_norm
-                    ) / agg_denom
+                    jnp.float32(client_dp_noise * clip_delta_norm)
+                    / agg_denom
                 )
                 out["mean_delta"] = _client_dp_noise(
                     dp_key, out["mean_delta"], std
@@ -891,7 +888,6 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              secagg_quant_step: float = 1e-4,
                              scan_unroll: int = 1,
                              client_dp_noise: float = 0.0,
-                             client_dp_max_weight: float = 1.0,
                              downlink: str = "",
                              downlink_levels: int = 256):
     """Reference-semantics engine: python loop over the cohort, jitted
@@ -1074,7 +1070,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             # same key derivation + per-leaf streams as the sharded
             # engine — parity holds on the noisy path too
             std = jnp.float32(
-                client_dp_noise * client_dp_max_weight * clip_delta_norm
+                client_dp_noise * clip_delta_norm
             ) / agg_denom
             mean_delta = _client_dp_noise(
                 jax.random.fold_in(rng, _CLIENT_DP_FOLD), mean_delta, std

@@ -201,7 +201,6 @@ class Experiment:
                     secagg=self.secagg,
                     secagg_quant_step=cfg.server.secagg_quant_step,
                     client_dp_noise=cfg.server.dp_client_noise_multiplier,
-                    client_dp_max_weight=self._client_dp_max_weight(),
                     downlink=cfg.server.downlink_compression,
                     downlink_levels=cfg.server.downlink_qsgd_levels,
                 )
@@ -229,7 +228,6 @@ class Experiment:
                 secagg_quant_step=cfg.server.secagg_quant_step,
                 scan_unroll=cfg.run.scan_unroll,
                 client_dp_noise=cfg.server.dp_client_noise_multiplier,
-                client_dp_max_weight=self._client_dp_max_weight(),
                 downlink=cfg.server.downlink_compression,
                 downlink_levels=cfg.server.downlink_qsgd_levels,
             )
@@ -333,12 +331,6 @@ class Experiment:
     def _local_dtype(self):
         d = self.cfg.run.local_param_dtype
         return _DTYPES[d] if d else None
-
-    def _client_dp_max_weight(self) -> float:
-        """Per-client max aggregation weight for the DP-FedAvg
-        sensitivity bound — always 1: client DP forces uniform
-        aggregation weights (see __init__)."""
-        return 1.0
 
     def _put(self, arr, sharding):
         if sharding is None:

@@ -1,0 +1,72 @@
+"""The private-FL recipe: secure aggregation + client-level DP + robust
+hygiene, end to end.
+
+What each layer buys (and what it does NOT):
+
+- ``server.clip_delta_norm`` — bounds every client's whole-tree update
+  L2 norm. Prerequisite for both privacy layers (it IS the sensitivity
+  bound) and a heterogeneity stabilizer on its own.
+- ``server.secure_aggregation`` — the server never sees an individual
+  client's update: uploads are fixed-point int32 masked with uniform
+  ring masks that cancel exactly (mod 2^32) in the aggregate. Hides
+  WHO sent WHAT; does not bound what the AGGREGATE reveals.
+- ``server.dp_client_noise_multiplier`` — central DP-FedAvg noise on
+  the aggregate with a formal (ε, δ) guarantee per client (reported as
+  ``dp_client_epsilon`` each round). Bounds what the aggregate (and
+  the final model) reveals about any one client; uniform aggregation
+  weights + a fixed public denominator are enforced automatically.
+- the two compose server-side in the deployed order: clip → mask →
+  aggregate/unmask → noise.
+
+Honesty note on the numbers this demo prints: with a smoke-scale
+federation (8 clients, cohort 4) and demo-level noise (z = 0.02) the
+reported ε is astronomically large — meaningful privacy needs z ≥ 1,
+thousands of clients, and small sampling rates, which trade accuracy
+for ε exactly as the DP-FedAvg paper describes. The demo shows the
+MECHANISM composing end to end, not a recommended privacy budget.
+
+Run: ``python examples/private_federated_training.py``
+(also executed by tests/test_examples.py, pinning the recipe).
+"""
+
+import json
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def main(out_dir: str = "/tmp/private_fl", echo: bool = True):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 8
+    cfg.server.cohort_size = 4
+    cfg.server.num_rounds = 16
+    cfg.server.eval_every = 4
+    cfg.run.out_dir = out_dir
+    cfg.run.metrics_flush_every = 4
+    cfg.data.synthetic_train_size = 512
+    cfg.data.synthetic_test_size = 256
+
+    # The privacy stack. The clip sets BOTH the secagg fixed-point range
+    # and the DP sensitivity — keep it at the scale updates actually
+    # have (here ≈1), not a loose bound: noise std = z·clip/K, so a 10×
+    # looser clip is 10× more noise for the same ε.
+    cfg.server.clip_delta_norm = 2.0           # sensitivity bound
+    cfg.server.secure_aggregation = True       # hide individual uploads
+    cfg.server.secagg_quant_step = 1e-4
+    cfg.server.dp_client_noise_multiplier = 0.02  # formal (ε, δ) per client
+
+    exp = Experiment(cfg.validate(), echo=echo)
+    state = exp.fit()
+    metrics = exp.evaluate(state["params"])
+    # per-client fairness view of the privately-trained model
+    metrics.update(exp.evaluate_federated(state["params"], max_clients=8))
+    metrics["dp_client_epsilon_total"] = round(
+        exp.dp_client_epsilon(int(state["round"])), 2
+    )
+    if echo:
+        print(json.dumps(metrics))
+    return metrics
+
+
+if __name__ == "__main__":
+    main()
