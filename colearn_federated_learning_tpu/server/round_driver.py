@@ -905,9 +905,9 @@ class Experiment:
         sampled-Gaussian RDP accountant (same closed form as the
         example-level accountant) composed over rounds with client
         sampling rate q = cohort/num_clients; δ from cfg.dp.delta.
-        Upper bound under uniform sampling (size-weighted sampling
-        raises a big client's q — config pairs weighted sampling with
-        uniform weights, and the reported q uses the uniform rate)."""
+        A sound upper bound because config.validate() REJECTS weighted
+        sampling under client DP (size-proportional sampling would push
+        a big client's per-round inclusion probability above q)."""
         from colearn_federated_learning_tpu.privacy.dp import rdp_epsilon
 
         q = min(1.0, self.cfg.server.cohort_size / self.fed.num_clients)

@@ -9,6 +9,36 @@ sequential oracle. Run: multihost_worker.py <pid> <nprocs> <port>.
 import os
 import sys
 
+import numpy as np
+
+
+def build_round_inputs():
+    """The deterministic round inputs SHARED by the worker and the
+    in-test sequential oracles (one definition — an edit here changes
+    both sides together, so the oracle comparison stays meaningful).
+    Returns plain numpy; includes the secagg variant's dropped client
+    and participant ring."""
+    rng = np.random.default_rng(0)
+    n, cohort, steps, batch = 64, 8, 2, 4
+    train_x = rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32)
+    train_y = rng.integers(0, 10, n).astype(np.int32)
+    idx = rng.integers(0, n, (cohort, steps, batch)).astype(np.int32)
+    mask = np.ones((cohort, steps, batch), np.float32)
+    n_ex = np.full((cohort,), float(steps * batch), np.float32)
+    # secagg variant: client 3 dropped; ring over the participants
+    n_ex_sa = n_ex.copy()
+    n_ex_sa[3] = 0.0
+    slots = np.arange(cohort, dtype=np.int32)
+    nxt = slots.copy()
+    parts = np.flatnonzero(n_ex_sa > 0)
+    nxt[parts] = np.roll(parts, -1)
+    return {
+        "cohort": cohort, "batch": batch,
+        "train_x": train_x, "train_y": train_y,
+        "idx": idx, "mask": mask, "n_ex": n_ex,
+        "n_ex_sa": n_ex_sa, "slots": slots, "nxt": nxt,
+    }
+
 
 def main():
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
@@ -31,7 +61,6 @@ def main():
     assert jax.device_count() == 4 * nprocs, jax.device_count()
 
     import jax.numpy as jnp
-    import numpy as np
 
     from colearn_federated_learning_tpu.config import ClientConfig, DPConfig, ServerConfig
     from colearn_federated_learning_tpu.models import build_model, init_params
@@ -44,16 +73,13 @@ def main():
     from colearn_federated_learning_tpu.parallel.round_engine import make_sharded_round_fn
     from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
 
-    # identical deterministic inputs on every host
+    # identical deterministic inputs on every host (and in the oracles)
     model = build_model("lenet5", num_classes=10)
     params = init_params(model, (28, 28, 1), seed=0)
-    rng = np.random.default_rng(0)
-    n, cohort, steps, batch = 64, 8, 2, 4
-    train_x = rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32)
-    train_y = rng.integers(0, 10, n).astype(np.int32)
-    idx = rng.integers(0, n, (cohort, steps, batch)).astype(np.int32)
-    mask = np.ones((cohort, steps, batch), np.float32)
-    n_ex = np.full((cohort,), float(steps * batch), np.float32)
+    inp = build_round_inputs()
+    cohort, batch = inp["cohort"], inp["batch"]
+    train_x, train_y = inp["train_x"], inp["train_y"]
+    idx, mask, n_ex = inp["idx"], inp["mask"], inp["n_ex"]
 
     mesh = build_client_mesh(8)  # spans both processes
     ccfg = ClientConfig(local_epochs=1, batch_size=batch, lr=0.1, momentum=0.9)
@@ -81,6 +107,36 @@ def main():
         f"MULTIHOST_OK pid={pid} loss={float(metrics.train_loss):.6f} "
         f"examples={float(metrics.examples):.1f} "
         f"leaf0={float(jnp.asarray(first_leaf).reshape(-1)[0]):.6f}",
+        flush=True,
+    )
+
+    # secure-aggregation round over the SAME cross-process mesh: the
+    # int32 mask psum crosses the process boundary and the masks must
+    # still cancel exactly (mod 2^32 is transport-agnostic) — one
+    # client dropped so the participant-ring repair is exercised too
+    sa_round = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "classify", mesh, server_update,
+        cohort_size=cohort, donate=False, clip_delta_norm=10.0,
+        secagg=True, secagg_quant_step=1e-4,
+    )
+    n_ex_sa, slots, nxt = inp["n_ex_sa"], inp["slots"], inp["nxt"]
+    sa_params, _, sa_metrics = sa_round(
+        put_rep(params),
+        put_rep(server_init(params)),
+        put_rep(train_x),
+        put_rep(train_y),
+        host_local_array(idx, cohort_sharded(mesh)),
+        host_local_array(mask, cohort_sharded(mesh)),
+        host_local_array(n_ex_sa, client_sharded(mesh)),
+        put_rep(np.asarray(jax.random.PRNGKey(7))),
+        host_local_array(slots, client_sharded(mesh)),
+        host_local_array(nxt, client_sharded(mesh)),
+    )
+    jax.block_until_ready(sa_params)
+    sa_leaf = jax.tree.leaves(sa_params)[0]
+    print(
+        f"MULTIHOST_SECAGG_OK pid={pid} loss={float(sa_metrics.train_loss):.6f} "
+        f"leaf0={float(jnp.asarray(sa_leaf).reshape(-1)[0]):.6f}",
         flush=True,
     )
 

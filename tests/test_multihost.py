@@ -1,13 +1,17 @@
-"""2-process loopback multihost test (SURVEY.md §3.5; VERDICT r1
-next-#5): jax.distributed bring-up over gRPC + gloo CPU collectives,
-8 global devices across 2 processes, one real sharded round whose psum
-crosses the process boundary (the DCN path, minus the distance)."""
+"""2-process loopback multihost tests (SURVEY.md §3.5): jax.distributed
+bring-up over gRPC + gloo CPU collectives, 8 global devices across 2
+processes. Three surfaces ride a REAL process boundary: a plain sharded
+round (the psum = the DCN path minus the distance), a secure-aggregation
+round (the int32 mask psum must cancel exactly), and a full
+``Experiment.fit`` with eval + orbax checkpoint + resume. The engine
+worker runs ONCE per session; both engine-level tests parse its output.
+"""
 
+import os
 import re
 import socket
 import subprocess
 import sys
-import os
 
 import numpy as np
 import pytest
@@ -15,6 +19,7 @@ import pytest
 pytestmark = pytest.mark.multihost
 
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+_FIT_WORKER = os.path.join(os.path.dirname(__file__), "multihost_fit_worker.py")
 
 
 def _free_port():
@@ -23,7 +28,11 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_loopback_round():
+def _run_workers(worker, extra_args=(), timeout=300):
+    """Launch the 2-process cluster, collect stdout, kill on ANY exit
+    path (a hung worker must not leak processes holding the coordinator
+    port for the rest of the CI run). Skips when the host lacks
+    cross-process CPU collectives."""
     port = _free_port()
     env = {
         k: v for k, v in os.environ.items()
@@ -31,117 +40,170 @@ def test_two_process_loopback_round():
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), "2", str(port)],
+            [sys.executable, worker, str(pid), "2", str(port), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        if p.returncode != 0 and (
-            "gloo" in err.lower() or "collectives" in err.lower()
-        ):
-            for q in procs:
-                q.kill()
-            pytest.skip(f"CPU cross-process collectives unavailable: {err[-300:]}")
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0 and (
+                "gloo" in err.lower() or "collectives" in err.lower()
+            ):
+                pytest.skip(
+                    f"CPU cross-process collectives unavailable: {err[-300:]}"
+                )
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
 
+
+def _parse(outs, pattern):
     parsed = []
     for out in outs:
-        m = re.search(
-            r"MULTIHOST_OK pid=(\d) loss=([\d.]+) examples=([\d.]+) leaf0=(-?[\d.]+)",
-            out,
-        )
+        m = re.search(pattern, out)
         assert m, out
         parsed.append(m.groups())
-    # both processes see the identical replicated result
-    assert parsed[0][1:] == parsed[1][1:], parsed
+    return parsed
 
-    # and it matches the single-process sequential oracle
-    from colearn_federated_learning_tpu.config import ClientConfig, DPConfig, ServerConfig
-    from colearn_federated_learning_tpu.models import build_model, init_params
-    from colearn_federated_learning_tpu.parallel.round_engine import (
-        make_sequential_round_fn,
+
+# the engine worker executes BOTH the plain and the secagg rounds in one
+# cluster bring-up; run it once and let both tests read the cache
+_engine_outputs = None
+
+
+def _engine_worker_outputs():
+    global _engine_outputs
+    if _engine_outputs is None:
+        _engine_outputs = _run_workers(_WORKER)
+    return _engine_outputs
+
+
+def _oracle_pieces():
+    """Sequential-oracle scaffolding on the SAME inputs as the workers
+    (tests/multihost_worker.py build_round_inputs — one definition)."""
+    from colearn_federated_learning_tpu.config import (
+        ClientConfig,
+        DPConfig,
+        ServerConfig,
     )
-    from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+    from colearn_federated_learning_tpu.models import build_model, init_params
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+    from tests.multihost_worker import build_round_inputs
+
+    inp = build_round_inputs()
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    ccfg = ClientConfig(
+        local_epochs=1, batch_size=inp["batch"], lr=0.1, momentum=0.9
+    )
+    scfg = ServerConfig(
+        optimizer="mean", server_lr=1.0, cohort_size=inp["cohort"]
+    )
+    server_init, server_update = make_server_update_fn(scfg)
+    return inp, model, params, ccfg, DPConfig(), server_init, server_update
+
+
+def test_two_process_loopback_round():
+    """Plain sharded round across the process boundary; both processes
+    identical and matching the single-process sequential oracle."""
     import jax
     import jax.numpy as jnp
 
-    model = build_model("lenet5", num_classes=10)
-    params = init_params(model, (28, 28, 1), seed=0)
-    rng = np.random.default_rng(0)
-    n, cohort, steps, batch = 64, 8, 2, 4
-    train_x = jnp.asarray(rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32))
-    train_y = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
-    idx = jnp.asarray(rng.integers(0, n, (cohort, steps, batch)).astype(np.int32))
-    mask = jnp.ones((cohort, steps, batch), jnp.float32)
-    n_ex = jnp.full((cohort,), float(steps * batch), jnp.float32)
-    ccfg = ClientConfig(local_epochs=1, batch_size=batch, lr=0.1, momentum=0.9)
-    scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=cohort)
-    init, server_update = make_server_update_fn(scfg)
-    seq = make_sequential_round_fn(model, ccfg, DPConfig(), "classify", server_update)
-    p_seq, _, m_seq = seq(params, init(params), train_x, train_y, idx, mask, n_ex,
-                          jax.random.PRNGKey(7))
-    np.testing.assert_allclose(float(parsed[0][1]), float(m_seq.train_loss), atol=1e-4)
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+    )
+
+    parsed = _parse(
+        _engine_worker_outputs(),
+        r"MULTIHOST_OK pid=(\d) loss=([\d.]+) examples=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    # both processes see the identical replicated result
+    assert parsed[0][1:] == parsed[1][1:], parsed
+
+    inp, model, params, ccfg, dp, server_init, server_update = _oracle_pieces()
+    seq = make_sequential_round_fn(model, ccfg, dp, "classify", server_update)
+    p_seq, _, m_seq = seq(
+        params, server_init(params),
+        jnp.asarray(inp["train_x"]), jnp.asarray(inp["train_y"]),
+        jnp.asarray(inp["idx"]), jnp.asarray(inp["mask"]),
+        jnp.asarray(inp["n_ex"]), jax.random.PRNGKey(7),
+    )
+    np.testing.assert_allclose(
+        float(parsed[0][1]), float(m_seq.train_loss), atol=1e-4
+    )
     leaf0 = float(np.asarray(jax.tree.leaves(p_seq)[0]).reshape(-1)[0])
     np.testing.assert_allclose(float(parsed[0][3]), leaf0, atol=1e-4)
 
 
-_FIT_WORKER = os.path.join(os.path.dirname(__file__), "multihost_fit_worker.py")
+def test_two_process_secagg_round():
+    """Secure aggregation across a REAL process boundary: the int32 mask
+    psum rides the cross-process collective and the ring cancellation
+    stays exact; both processes agree and match the single-process
+    sequential secagg oracle (with the same dropped client)."""
+    import jax
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+    )
+
+    parsed = _parse(
+        _engine_worker_outputs(),
+        r"MULTIHOST_SECAGG_OK pid=(\d) loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert parsed[0][1:] == parsed[1][1:], parsed
+
+    inp, model, params, ccfg, dp, server_init, server_update = _oracle_pieces()
+    seq = make_sequential_round_fn(
+        model, ccfg, dp, "classify", server_update,
+        clip_delta_norm=10.0, secagg=True, secagg_quant_step=1e-4,
+    )
+    p_seq, _, m_seq = seq(
+        params, server_init(params),
+        jnp.asarray(inp["train_x"]), jnp.asarray(inp["train_y"]),
+        jnp.asarray(inp["idx"]), jnp.asarray(inp["mask"]),
+        jnp.asarray(inp["n_ex_sa"]), jax.random.PRNGKey(7),
+        slots=jnp.asarray(inp["slots"]), next_slots=jnp.asarray(inp["nxt"]),
+    )
+    np.testing.assert_allclose(
+        float(parsed[0][1]), float(m_seq.train_loss), atol=1e-4
+    )
+    leaf0 = float(np.asarray(jax.tree.leaves(p_seq)[0]).reshape(-1)[0])
+    np.testing.assert_allclose(float(parsed[0][2]), leaf0, atol=1e-4)
 
 
 def test_two_process_fit_eval_checkpoint_resume(tmp_path):
     """Driver-level multihost (VERDICT r2 missing-#2): Experiment.fit
     runs eval + orbax checkpoint + resume in BOTH processes; metrics are
     single-writer; final params identical on both hosts."""
-    port = _free_port()
-    out_dir = str(tmp_path / "runs")
-    env = {
-        k: v for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _FIT_WORKER, str(pid), "2", str(port), out_dir],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        if p.returncode != 0 and (
-            "gloo" in err.lower() or "collectives" in err.lower()
-        ):
-            for q in procs:
-                q.kill()
-            pytest.skip(f"CPU cross-process collectives unavailable: {err[-300:]}")
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(out)
+    import json
+    import pathlib
 
-    parsed = []
-    for out in outs:
-        m = re.search(
-            r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
-            r"loss=([\d.]+) leaf0=(-?[\d.]+)",
-            out,
-        )
-        assert m, out
-        parsed.append(m.groups())
+    out_dir = str(tmp_path / "runs")
+    outs = _run_workers(_FIT_WORKER, extra_args=(out_dir,), timeout=600)
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
     # both processes completed 6 rounds and hold IDENTICAL final params
     assert parsed[0][1] == parsed[1][1] == "6", parsed
     assert parsed[0][2:] == parsed[1][2:], parsed
 
     # single-writer metrics: exactly ONE metrics file, written by proc 0
-    metrics_files = list(
-        __import__("pathlib").Path(out_dir).glob("*.metrics.jsonl")
-    )
+    metrics_files = list(pathlib.Path(out_dir).glob("*.metrics.jsonl"))
     assert len(metrics_files) == 1, metrics_files
     lines = [
-        __import__("json").loads(ln)
-        for ln in metrics_files[0].read_text().splitlines()
+        json.loads(ln) for ln in metrics_files[0].read_text().splitlines()
     ]
     # the resumed phase logged its resume event and rounds 5..6
     assert any(r.get("event") == "resumed" for r in lines), lines
@@ -150,7 +212,7 @@ def test_two_process_fit_eval_checkpoint_resume(tmp_path):
     # orbax wrote real checkpoint steps under the run dir
     ckpts = sorted(
         int(p.name) for p in
-        (__import__("pathlib").Path(out_dir) / "mnist_fedavg_2" / "ckpt").iterdir()
+        (pathlib.Path(out_dir) / "mnist_fedavg_2" / "ckpt").iterdir()
         if p.name.isdigit()
     )
     assert 4 in ckpts and 6 in ckpts, ckpts
