@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 # First recorded rounds/sec per config on 1× TPU v5 lite (BASELINE.md
@@ -38,6 +39,24 @@ BASELINES = {
     "shakespeare_fedavg": 6.71,
     "imagenet_silo_dp": 0.31,
 }
+
+# Device-side ms/round baselines (from the round-4 profiled measurement,
+# BASELINE.md r4 table). For DISPATCH-BOUND configs (MFU < 5%) the wall
+# r/s number is mostly relay weather — a 2× real regression could hide
+# inside the relay's 2-3× load swing — so vs_baseline for those configs
+# gates on the round program's measured DEVICE time instead, which is
+# weather-independent (VERDICT r3 weak-#5).
+DEVICE_MS_BASELINES = {
+    # r4 first measurements (BASELINE.md r4 width-sweep table): femnist
+    # at its fastest width (1), shakespeare at its adopted width (0 =
+    # full lane)
+    "femnist_fedprox_500": 32.6,
+    "shakespeare_fedavg": 6.2,
+}
+
+# gate on device time only when the MXU is starved; above this the wall
+# clock is device-dominated and r/s is the honest metric
+DISPATCH_BOUND_MFU_PCT = 5.0
 
 # Dense bf16 peak of one TPU v5e (v5 lite) chip. MFU = achieved/peak; the
 # FLOP count comes from XLA's cost model of ONE scan-free train step
@@ -88,6 +107,89 @@ def _round_flops(exp, state):
         return float(ca["flops"]) * exp.shape.steps * exp.cfg.server.cohort_size
     except Exception:
         return None
+
+
+def _parse_device_ms(profile_dir: str, fn_prefix: str = "jit_round_fn"):
+    """Mean duration (ms) of the round program's DEVICE executions in a
+    ``jax.profiler`` trace directory.
+
+    The perfetto trace contains ``jit_round_fn`` spans on both the host
+    (dispatch, ~ms) and the device (execution, the number we want); the
+    device track is identified as the pid whose spans carry the most
+    total time — dispatch spans are orders of magnitude shorter than
+    executions for every config benched here. Returns None when no
+    trace or no matching spans exist."""
+    import glob
+    import gzip
+    import json as _json
+
+    events = []
+    for pattern in ("*.trace.json.gz", "*.trace.json"):
+        for path in glob.glob(
+            os.path.join(profile_dir, "**", pattern), recursive=True
+        ):
+            opener = gzip.open if path.endswith(".gz") else open
+            try:
+                with opener(path, "rt") as f:
+                    events.extend(_json.load(f).get("traceEvents", []))
+            except Exception:
+                continue
+    by_pid = {}
+    for e in events:
+        if e.get("ph") == "X" and str(e.get("name", "")).startswith(fn_prefix):
+            by_pid.setdefault(e.get("pid"), []).append(float(e.get("dur", 0)))
+    if not by_pid:
+        return None
+    durs = max(by_pid.values(), key=sum)
+    return sum(durs) / len(durs) / 1000.0  # µs → ms
+
+
+def _measure_device_ms(exp, state, start_round: int, rounds: int = 4):
+    """Trace ``rounds`` dispatched rounds and return (state, mean device
+    ms/round). The drain inside the trace forces execution so the trace
+    contains the device work (block_until_ready does not force through
+    the axon relay)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="bench_profile_")
+    try:
+        jax.profiler.start_trace(tmp)
+        pending = []
+        for r in range(start_round, start_round + rounds):
+            state = exp.run_round(state, r)
+            pending.append(state.pop("_metrics"))
+        jax.device_get(pending)
+        jax.profiler.stop_trace()
+        return state, _parse_device_ms(tmp)
+    except Exception:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        return state, None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _gate(name: str, rounds_per_sec: float, device_ms, mfu_pct):
+    """(vs_baseline, basis): wall-clock r/s against BASELINES, unless
+    the config is dispatch-bound (MFU < DISPATCH_BOUND_MFU_PCT, or MFU
+    unknowable because the backend lacks a cost model — matching the
+    measurement condition in bench_config) and a device-time baseline
+    exists — then baseline_ms / measured_ms, which regresses
+    independently of relay weather. Pure function so the
+    2×-regression-trips-the-gate property is unit-testable."""
+    if (
+        device_ms
+        and (mfu_pct is None or mfu_pct < DISPATCH_BOUND_MFU_PCT)
+        and name in DEVICE_MS_BASELINES
+    ):
+        return DEVICE_MS_BASELINES[name] / device_ms, "device_ms"
+    baseline = BASELINES.get(name)
+    return (rounds_per_sec / baseline if baseline else 1.0), "rounds_per_sec"
 
 
 def _hbm_stats():
@@ -157,9 +259,21 @@ def bench_config(name: str):
     updates_per_sec_per_chip = (
         timed * cfg.server.cohort_size / dt / exp.n_chips
     )
-    baseline = BASELINES.get(name)
-    vs = rounds_per_sec / baseline if baseline else 1.0
+    flops_pct = None
+    if flops_per_round:
+        flops_pct = (
+            100.0 * flops_per_round * rounds_per_sec
+            / (PEAK_BF16_FLOPS * exp.n_chips)
+        )
+    # device-time pass for gating (skipped where wall r/s already gates)
+    device_ms = None
+    if name in DEVICE_MS_BASELINES and (
+        flops_pct is None or flops_pct < DISPATCH_BOUND_MFU_PCT
+    ):
+        state, device_ms = _measure_device_ms(exp, state, warmup + timed)
+    vs, vs_basis = _gate(name, rounds_per_sec, device_ms, flops_pct)
     extra = {
+        "vs_baseline_basis": vs_basis,
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
         "n_chips": exp.n_chips,
         "timed_rounds": timed,
@@ -170,12 +284,13 @@ def bench_config(name: str):
     }
     for k, v in overrides.items():
         extra[f"override:{k}"] = v
+    if device_ms is not None:
+        extra["device_ms_per_round"] = round(device_ms, 3)
     if flops_per_round:
-        achieved = flops_per_round * rounds_per_sec
         extra.update({
             "model_tflops_per_round": round(flops_per_round / 1e12, 3),
-            "achieved_tflops": round(achieved / 1e12, 2),
-            "mfu_pct": round(100.0 * achieved / (PEAK_BF16_FLOPS * exp.n_chips), 2),
+            "achieved_tflops": round(flops_per_round * rounds_per_sec / 1e12, 2),
+            "mfu_pct": round(flops_pct, 2),
         })
     hbm = _hbm_stats()
     if hbm:

@@ -40,6 +40,11 @@ class DataConfig:
     synthetic_fallback: bool = True
     synthetic_train_size: int = 2048
     synthetic_test_size: int = 512
+    # Synthetic image SNR: x = w·class_template + (1−w)·noise. 0.7 is an
+    # easy task (saturates at acc 1.0 — right for smoke tests); the
+    # convergence regression lowers it so the plateau sits strictly
+    # below 1.0 and a mid-curve band can catch subtle aggregation drift.
+    synthetic_template_weight: float = 0.7
     # Cap on examples a client contributes per round (static-shape pad target;
     # 0 = derive from the largest client shard).
     max_examples_per_client: int = 0
@@ -116,6 +121,14 @@ class ServerConfig:
     # algorithm=feddyn only: the dynamic-regularization coefficient α
     # (both the client proximal pull and the server h-correction scale)
     feddyn_alpha: float = 0.1
+    # scaffold/feddyn only: storage dtype of the device-resident
+    # per-client state store (the [N, ...] stacked cᵢ/gᵢ tree, sharded
+    # over the mesh's clients axis under run.engine=sharded). The HBM
+    # budget is N·|params| at this dtype, divided across lanes.
+    # "bfloat16" halves it but rounds the PERSISTENT state at each
+    # scatter-back (in-round state math always runs f32); keep
+    # "float32" unless the store dominates HBM.
+    client_state_dtype: str = "float32"  # float32 | bfloat16
     # Cohort sampling: uniform over clients, or weighted with
     # p ∝ client shard size (big-data clients drawn more often; pairs
     # with uniform aggregation weights — the standard importance-sampling
@@ -135,23 +148,35 @@ class ServerConfig:
     # Secure aggregation — the masking core of Bonawitz et al. 2017,
     # simulated faithfully at the arithmetic level: each participant's
     # weighted delta is quantized to fixed-point int32 and additively
-    # masked with UNIFORM int32 ring masks m(slot) − m(next_participant)
+    # masked with UNIFORM int32 ring masks m(slot) − m(slot+1 mod K)
     # that cancel EXACTLY (mod 2^32) in the aggregate psum, so the
     # server-visible per-client contribution is information-
     # theoretically hidden while the aggregate is exact up to the
-    # quantization step. Dropout is handled by building the mask ring
-    # over the round's actual participants (known host-side before
-    # dispatch — the simulation's stand-in for the protocol's
-    # secret-sharing recovery). Scope: the key-agreement/secret-sharing
-    # layers of the real protocol are out of simulation scope, and the
-    # loss/example-count metrics still aggregate in plaintext (as
-    # published deployments also do for counts). Requires
-    # clip_delta_norm > 0 so |quantized values| are bounded:
-    # cohort · max_weight · clip / quant_step must stay < 2^31 (and
-    # per-client values < 2^24 for exact f32 rounding).
+    # quantization step. The mask ring is the STATIC full cohort,
+    # committed BEFORE training: dropout is discovered only after
+    # uploads are collected, and the server then reconstructs each
+    # dropped client's mask term m(slot) − m(slot+1) from the recovered
+    # mask seed and adds it so the ring still telescopes to zero — the
+    # real protocol's post-upload seed-share recovery, with the shared
+    # mask key standing in for Shamir reconstruction. The dropped
+    # client's data never enters the aggregate. Scope: the
+    # key-agreement/secret-sharing layers of the real protocol are out
+    # of simulation scope, and the loss/example-count metrics still
+    # aggregate in plaintext (as published deployments also do for
+    # counts). Requires clip_delta_norm > 0 so |quantized values| are
+    # bounded: cohort · max_weight · clip / quant_step must stay < 2^31
+    # (enforced at Experiment construction — see secagg_allow_wrap_risk)
+    # and per-client values < 2^24 for exact f32 rounding (warned).
     secure_aggregation: bool = False
     # fixed-point quantization step for secure aggregation
     secagg_quant_step: float = 1e-4
+    # An int32 WRAP in the masked aggregate silently corrupts the round,
+    # so a config whose worst-case bound cohort·max_weight·clip/
+    # quant_step reaches 2^31 is REJECTED at Experiment construction
+    # unless this explicit opt-in is set (the run then only warns).
+    # Realized deltas usually sit far below the clip bound — but that is
+    # a statistical observation, not a guarantee, hence opt-in.
+    secagg_allow_wrap_risk: bool = False
     # Central CLIENT-level DP (DP-FedAvg, McMahan et al. 2018 "Learning
     # Differentially Private Recurrent Language Models"): Gaussian noise
     # with std z·S/K is added ONCE to the aggregated mean delta, where
@@ -559,11 +584,21 @@ class ExperimentConfig:
                 f"server.straggler_work must be in (0, 1], "
                 f"got {self.server.straggler_work}"
             )
+        if self.server.client_state_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown server.client_state_dtype "
+                f"{self.server.client_state_dtype!r}"
+            )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
         if self.run.scan_unroll < 1:
             raise ValueError(
                 f"run.scan_unroll must be >= 1, got {self.run.scan_unroll}"
+            )
+        if not 0.0 < self.data.synthetic_template_weight <= 1.0:
+            raise ValueError(
+                f"data.synthetic_template_weight must be in (0, 1], "
+                f"got {self.data.synthetic_template_weight}"
             )
         if self.data.placement not in ("hbm", "stream"):
             raise ValueError(f"unknown data.placement {self.data.placement!r}")
@@ -624,7 +659,14 @@ class ExperimentConfig:
             obj = self
             *head, last = dotted.split(".")
             for part in head:
-                obj = obj[part] if isinstance(obj, dict) else getattr(obj, part)
+                if isinstance(obj, dict):
+                    obj = obj[part]
+                elif hasattr(obj, part):
+                    obj = getattr(obj, part)
+                else:
+                    # unknown section must fail the same clean way as an
+                    # unknown leaf (CLI turns KeyError into exit 2)
+                    raise KeyError(f"unknown config path {dotted!r}")
             if isinstance(obj, dict):
                 obj[last] = value
                 continue
@@ -743,7 +785,12 @@ def _shakespeare_fedavg() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=16, lr=0.5),
         server=ServerConfig(num_rounds=200, cohort_size=8, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+        # width=0 = whole lane as one vmap block: BERT-tiny at batch 16
+        # starves the MXU, and the r4 sweep measured a monotone
+        # device-time win 7.0 → 6.24 ms/round from widening to the full
+        # lane (BASELINE.md r4); 0 adapts to any lane count.
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
+                      client_vmap_width=0),
     )
 
 

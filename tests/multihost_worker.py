@@ -17,7 +17,7 @@ def build_round_inputs():
     in-test sequential oracles (one definition — an edit here changes
     both sides together, so the oracle comparison stays meaningful).
     Returns plain numpy; includes the secagg variant's dropped client
-    and participant ring."""
+    (the mask ring itself is static — engine-internal)."""
     rng = np.random.default_rng(0)
     n, cohort, steps, batch = 64, 8, 2, 4
     train_x = rng.uniform(0, 1, (n, 28, 28, 1)).astype(np.float32)
@@ -25,18 +25,14 @@ def build_round_inputs():
     idx = rng.integers(0, n, (cohort, steps, batch)).astype(np.int32)
     mask = np.ones((cohort, steps, batch), np.float32)
     n_ex = np.full((cohort,), float(steps * batch), np.float32)
-    # secagg variant: client 3 dropped; ring over the participants
+    # secagg variant: client 3 dropped (post-upload mask reconstruction)
     n_ex_sa = n_ex.copy()
     n_ex_sa[3] = 0.0
-    slots = np.arange(cohort, dtype=np.int32)
-    nxt = slots.copy()
-    parts = np.flatnonzero(n_ex_sa > 0)
-    nxt[parts] = np.roll(parts, -1)
     return {
         "cohort": cohort, "batch": batch,
         "train_x": train_x, "train_y": train_y,
         "idx": idx, "mask": mask, "n_ex": n_ex,
-        "n_ex_sa": n_ex_sa, "slots": slots, "nxt": nxt,
+        "n_ex_sa": n_ex_sa,
     }
 
 
@@ -113,13 +109,14 @@ def main():
     # secure-aggregation round over the SAME cross-process mesh: the
     # int32 mask psum crosses the process boundary and the masks must
     # still cancel exactly (mod 2^32 is transport-agnostic) — one
-    # client dropped so the participant-ring repair is exercised too
+    # client dropped, so the server-side post-upload mask
+    # reconstruction is exercised across the boundary too
     sa_round = make_sharded_round_fn(
         model, ccfg, DPConfig(), "classify", mesh, server_update,
         cohort_size=cohort, donate=False, clip_delta_norm=10.0,
         secagg=True, secagg_quant_step=1e-4,
     )
-    n_ex_sa, slots, nxt = inp["n_ex_sa"], inp["slots"], inp["nxt"]
+    n_ex_sa = inp["n_ex_sa"]
     sa_params, _, sa_metrics = sa_round(
         put_rep(params),
         put_rep(server_init(params)),
@@ -129,8 +126,6 @@ def main():
         host_local_array(mask, cohort_sharded(mesh)),
         host_local_array(n_ex_sa, client_sharded(mesh)),
         put_rep(np.asarray(jax.random.PRNGKey(7))),
-        host_local_array(slots, client_sharded(mesh)),
-        host_local_array(nxt, client_sharded(mesh)),
     )
     jax.block_until_ready(sa_params)
     sa_leaf = jax.tree.leaves(sa_params)[0]

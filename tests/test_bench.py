@@ -1,0 +1,85 @@
+"""bench.py's device-time regression gate (VERDICT r3 weak-#5): for
+dispatch-bound configs (MFU < 5%) ``vs_baseline`` must gate on the round
+program's measured DEVICE time — relay load swings wall r/s 2-3×, so a
+2× real regression could hide inside the weather. Pinned here: the
+perfetto-trace parser (host/device track disambiguation) and the pure
+gating rule, including that a simulated 2× device-time regression trips
+the gate under ANY wall-clock reading."""
+
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_parse_device_ms_picks_device_track(tmp_path):
+    """Host dispatch spans share the fn name; the parser must choose the
+    track with the dominant total time (the device executions)."""
+    events = [
+        # host dispatch spans: pid 1, ~2ms each
+        {"ph": "X", "pid": 1, "name": "jit_round_fn", "dur": 2000},
+        {"ph": "X", "pid": 1, "name": "jit_round_fn", "dur": 2100},
+        # device execution spans: pid 7, ~50ms each
+        {"ph": "X", "pid": 7, "name": "jit_round_fn.12", "dur": 50000},
+        {"ph": "X", "pid": 7, "name": "jit_round_fn.12", "dur": 52000},
+        # unrelated op
+        {"ph": "X", "pid": 7, "name": "fusion.3", "dur": 9000},
+        # metadata event (no dur)
+        {"ph": "M", "pid": 7, "name": "process_name"},
+    ]
+    _write_trace(str(tmp_path / "host.trace.json.gz"), events)
+    ms = bench._parse_device_ms(str(tmp_path))
+    assert ms == (50.0 + 52.0) / 2
+
+
+def test_parse_device_ms_empty(tmp_path):
+    assert bench._parse_device_ms(str(tmp_path)) is None
+
+
+def test_gate_uses_device_time_for_dispatch_bound_configs():
+    name = "femnist_fedprox_500"
+    base_ms = bench.DEVICE_MS_BASELINES[name]
+    # healthy: device time at baseline → vs ≈ 1 on the device basis
+    vs, basis = bench._gate(name, rounds_per_sec=6.0,
+                            device_ms=base_ms, mfu_pct=1.2)
+    assert basis == "device_ms" and abs(vs - 1.0) < 1e-9
+    # simulated 2× device-time regression: trips the gate EVEN IF the
+    # wall clock reads better than baseline (quiet relay window)
+    vs, basis = bench._gate(name, rounds_per_sec=19.0,
+                            device_ms=2 * base_ms, mfu_pct=1.2)
+    assert basis == "device_ms" and vs == 0.5
+    # and a 2× device-time WIN reads as 2× regardless of a loaded relay
+    vs, _ = bench._gate(name, rounds_per_sec=2.0,
+                        device_ms=base_ms / 2, mfu_pct=1.2)
+    assert vs == 2.0
+
+
+def test_gate_keeps_wall_clock_for_device_bound_configs():
+    """High-MFU configs gate on wall r/s (device-dominated clock), and
+    configs without a device baseline fall back to r/s too."""
+    vs, basis = bench._gate("cifar10_fedavg_100", rounds_per_sec=3.3,
+                            device_ms=280.0, mfu_pct=40.0)
+    assert basis == "rounds_per_sec"
+    assert vs == 3.3 / bench.BASELINES["cifar10_fedavg_100"]
+    # no trace available (device_ms None) → honest fallback
+    vs, basis = bench._gate("shakespeare_fedavg", rounds_per_sec=6.71,
+                            device_ms=None, mfu_pct=0.7)
+    assert basis == "rounds_per_sec" and abs(vs - 1.0) < 1e-9
+
+
+def test_gate_unknown_mfu_counts_as_dispatch_bound():
+    """No cost model (mfu None) must not silently disable the device
+    gate — it matches bench_config's measurement condition."""
+    name = "shakespeare_fedavg"
+    vs, basis = bench._gate(name, rounds_per_sec=40.0,
+                            device_ms=2 * bench.DEVICE_MS_BASELINES[name],
+                            mfu_pct=None)
+    assert basis == "device_ms" and vs == 0.5

@@ -25,7 +25,7 @@ from tests.test_secagg import _setup
 
 def test_zero_noise_is_exactly_plain():
     (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
-     n_ex, slots, nxt) = _setup()
+     n_ex) = _setup()
     mk = lambda **kw: make_sequential_round_fn(  # noqa: E731
         model, ccfg, DPConfig(), "classify", server_update,
         clip_delta_norm=10.0, **kw,
@@ -47,7 +47,7 @@ def test_noise_magnitude_matches_calibration():
     fixed-denominator calibration z·clip/K — never the realized
     (private) weight sum."""
     (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
-     n_ex, slots, nxt) = _setup()
+     n_ex) = _setup()
     z, clip = 2.0, 10.0
     rng = jax.random.PRNGKey(9)
     plain = make_sequential_round_fn(
@@ -88,7 +88,7 @@ def test_client_dp_sharded_matches_sequential(with_secagg):
     """Same rng ⇒ same noise streams in both engines; with secagg the
     noise rides on top of the exactly-unmasked aggregate."""
     (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
-     n_ex, slots, nxt) = _setup()
+     n_ex) = _setup()
     kw = dict(clip_delta_norm=10.0, client_dp_noise=0.7, agg="uniform")
     if with_secagg:
         kw.update(secagg=True, secagg_quant_step=1e-4)
@@ -102,14 +102,10 @@ def test_client_dp_sharded_matches_sequential(with_secagg):
     )
     rng = jax.random.PRNGKey(13)
     args = (params, server_init(params), tx, ty, idx, mask, n_ex, rng)
-    if with_secagg:
-        p_sh, _, _ = sharded(*args, slots, nxt)
-        p_sq, _, _ = seq(*args, slots=slots, next_slots=nxt)
-        atol = 5e-6  # quantization-bucket flips (see test_secagg)
-    else:
-        p_sh, _, _ = sharded(*args)
-        p_sq, _, _ = seq(*args)
-        atol = 1e-6
+    p_sh, _, _ = sharded(*args)
+    p_sq, _, _ = seq(*args)
+    # with secagg: quantization-bucket flips (see test_secagg)
+    atol = 5e-6 if with_secagg else 1e-6
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=atol

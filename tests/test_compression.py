@@ -206,7 +206,7 @@ class TestDownlink:
         from tests.test_secagg import _setup
 
         (model, params, ccfg, server_init, server_update, tx, ty, idx, mask,
-         n_ex, slots, nxt) = _setup()
+         n_ex) = _setup()
         kw = dict(downlink="qsgd", downlink_levels=64)
         mesh = build_client_mesh(8)
         sharded = make_sharded_round_fn(

@@ -1,9 +1,34 @@
 """docs/CONFIG.md is generated from the live dataclasses — regenerate
-and diff so a config change can't silently leave the doc stale."""
+and diff so a config change can't silently leave the doc stale.
+docs/DESIGN.md's layer-map module list is checked against the real tree
+so a moved/renamed module can't silently orphan the architecture doc."""
 
 import os
+import re
 
 from colearn_federated_learning_tpu.utils.docgen import config_reference_markdown
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_design_doc_modules_exist():
+    """Every `module.py` / `dir/` path named in DESIGN.md's layer table
+    must exist under the package (README links the doc; a stale module
+    list would send a newcomer to files that aren't there)."""
+    with open(os.path.join(_ROOT, "docs", "DESIGN.md")) as f:
+        text = f.read()
+    # backticked paths inside the layer table, e.g. `server/round_driver.py`
+    paths = set(re.findall(r"`([\w/]+\.(?:py|cpp))`", text))
+    assert len(paths) >= 15, sorted(paths)  # the table really was parsed
+    pkg = os.path.join(_ROOT, "colearn_federated_learning_tpu")
+    missing = []
+    for rel in sorted(paths):
+        if not (
+            os.path.exists(os.path.join(pkg, rel))      # package module
+            or os.path.exists(os.path.join(_ROOT, rel))  # repo-level path
+        ):
+            missing.append(rel)
+    assert not missing, f"DESIGN.md names modules that don't exist: {missing}"
 
 
 def test_config_reference_is_current():

@@ -62,12 +62,13 @@ def test_first_round_identities():
         num_clients=8, feddyn_alpha=ALPHA,
     )
     h0, g0 = _zero_state(params, 4)
-    p1, _, h1, g1, m = fn(
+    p1, _, h1, store1, m = fn(
         params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
         jnp.asarray(n_ex), jax.random.PRNGKey(0), h0, g0,
+        jnp.arange(4, dtype=jnp.int32),
     )
     # recover per-client deltas from g₁ = −α·Δ and check server math
-    deltas = jax.tree.map(lambda g: -np.asarray(g) / ALPHA, g1)
+    deltas = jax.tree.map(lambda g: -np.asarray(g)[:4] / ALPHA, store1)
     h_want = jax.tree.map(lambda d: -ALPHA * d.sum(0) / 8.0, deltas)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4,
@@ -108,16 +109,23 @@ def test_feddyn_sharded_matches_sequential(lanes):
         lambda p: jnp.asarray(0.01 * rngs.normal(size=p.shape).astype(np.float32)),
         params,
     )
-    g0 = jax.tree.map(
+    # full 16-client store for the sharded engine; the oracle gets the
+    # cohort rows (clients 8..15 — exercises the in-program gather)
+    store0 = jax.tree.map(
         lambda p: jnp.asarray(
-            0.01 * rngs.normal(size=(8,) + p.shape).astype(np.float32)
+            0.01 * rngs.normal(size=(16,) + p.shape).astype(np.float32)
         ),
         params,
     )
+    cohort = np.arange(8, 16, dtype=np.int32)
+    g0 = jax.tree.map(lambda a: a[jnp.asarray(cohort)], store0)
     args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
-            jax.random.PRNGKey(42), h0, g0)
-    p_sh, _, h_sh, g_sh, m_sh = sharded(params, init(params), *args)
-    p_sq, _, h_sq, g_sq, m_sq = sequential(params, init(params), *args)
+            jax.random.PRNGKey(42))
+    p_sh, _, h_sh, store_sh, m_sh = sharded(
+        params, init(params), *args, h0, store0, jnp.asarray(cohort)
+    )
+    p_sq, _, h_sq, g_sq, m_sq = sequential(params, init(params), *args, h0, g0)
+    g_sh = jax.tree.map(lambda a: np.asarray(a)[cohort], store_sh)
     for got, want in ((p_sh, p_sq), (h_sh, h_sq), (g_sh, g_sq)):
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
@@ -147,7 +155,10 @@ def test_feddyn_e2e_h_mean_invariant(tmp_path):
     exp = Experiment(cfg, echo=False)
     state = exp.fit()
     assert exp.feddyn and exp.stateful
-    g_mean = jax.tree.map(lambda a: a.mean(0), state["c_clients"])
+    n = cfg.data.num_clients  # ignore lane-pad rows (always zero)
+    g_mean = jax.tree.map(
+        lambda a: np.asarray(a)[:n].mean(0), state["c_clients"]
+    )
     jax.tree.map(
         lambda h, gm: np.testing.assert_allclose(
             np.asarray(h), np.asarray(gm), rtol=1e-4, atol=1e-6

@@ -42,12 +42,14 @@ def _setup(cohort=8, n=256, steps=RoundShape(2, 4, 8, 32)):
     return model, params, x, y, idx, mask, n_ex
 
 
-def _c_state(params, cohort, seed=None):
-    """(c_global, c_cohort) — zeros, or random f32 when seeded."""
+def _c_state(params, rows, seed=None):
+    """(c_global, [rows, ...] state stack) — zeros, or random f32 when
+    seeded. The stack doubles as the sharded engine's full store (rows =
+    lane-padded N) and, row-sliced, as the oracle's cohort state."""
     if seed is None:
         cg = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         cc = jax.tree.map(
-            lambda p: jnp.zeros((cohort,) + p.shape, jnp.float32), params
+            lambda p: jnp.zeros((rows,) + p.shape, jnp.float32), params
         )
         return cg, cc
     rngs = np.random.default_rng(seed)
@@ -59,7 +61,7 @@ def _c_state(params, cohort, seed=None):
     )
     cc = jax.tree.map(
         lambda p: jnp.asarray(
-            0.01 * rngs.normal(size=(cohort,) + p.shape).astype(np.float32)
+            0.01 * rngs.normal(size=(rows,) + p.shape).astype(np.float32)
         ),
         params,
     )
@@ -100,6 +102,10 @@ def test_one_step_c_update_equals_batch_gradient():
 
 @pytest.mark.parametrize("lanes", [8, 4, 1])
 def test_scaffold_sharded_matches_sequential(lanes):
+    """Device-resident state store: the sharded engine takes the FULL
+    [N_pad, ...] store + cohort ids and gathers/scatters in-program; the
+    oracle takes the cohort rows host-side. Cohort ids are non-trivial
+    (odd clients of N=16) so the in-program gather is really exercised."""
     model, params, x, y, idx, mask, n_ex = _setup(cohort=8)
     ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1, momentum=0.0)
     scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
@@ -113,16 +119,29 @@ def test_scaffold_sharded_matches_sequential(lanes):
         model, ccfg, DPConfig(), "classify", server_update,
         scaffold=True, num_clients=16,
     )
-    cg, cc = _c_state(params, 8, seed=5)
+    cohort = np.arange(1, 16, 2, dtype=np.int32)  # clients 1,3,...,15
+    cg, store = _c_state(params, 16, seed=5)
+    cc = jax.tree.map(lambda a: a[jnp.asarray(cohort)], store)
     args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
-            jax.random.PRNGKey(42), cg, cc)
-    p_sh, _, cg_sh, cc_sh, m_sh = sharded(params, init(params), *args)
-    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args)
+            jax.random.PRNGKey(42))
+    p_sh, _, cg_sh, store_sh, m_sh = sharded(
+        params, init(params), *args, cg, store, jnp.asarray(cohort)
+    )
+    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args, cg, cc)
+    cc_sh = jax.tree.map(lambda a: np.asarray(a)[cohort], store_sh)
     for got, want in ((p_sh, p_sq), (cg_sh, cg_sq), (cc_sh, cc_sq)):
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
             got, want,
         )
+    # rows outside the cohort are untouched
+    other = np.arange(0, 16, 2)
+    jax.tree.map(
+        lambda new, old: np.testing.assert_array_equal(
+            np.asarray(new)[other], np.asarray(old)[other]
+        ),
+        store_sh, store,
+    )
     np.testing.assert_allclose(m_sh.train_loss, m_sq.train_loss, rtol=1e-5)
 
 
@@ -143,11 +162,16 @@ def test_scaffold_batch_sharded_matches_sequential():
         model, ccfg, DPConfig(), "classify", server_update,
         scaffold=True, num_clients=8,
     )
-    cg, cc = _c_state(params, 4, seed=11)
+    cohort = np.arange(4, dtype=np.int32)
+    cg, store = _c_state(params, 8, seed=11)
+    cc = jax.tree.map(lambda a: a[jnp.asarray(cohort)], store)
     args = (x, y, jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(n_ex),
-            jax.random.PRNGKey(9), cg, cc)
-    p_sh, _, cg_sh, cc_sh, m_sh = sharded(params, init(params), *args)
-    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args)
+            jax.random.PRNGKey(9))
+    p_sh, _, cg_sh, store_sh, m_sh = sharded(
+        params, init(params), *args, cg, store, jnp.asarray(cohort)
+    )
+    p_sq, _, cg_sq, cc_sq, m_sq = sequential(params, init(params), *args, cg, cc)
+    cc_sh = jax.tree.map(lambda a: np.asarray(a)[cohort], store_sh)
     for got, want in ((p_sh, p_sq), (cg_sh, cg_sq), (cc_sh, cc_sq)):
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
@@ -183,6 +207,7 @@ def test_scaffold_bf16_params_dc_carry():
     p, _, cg2, cc2, m = fn(
         params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
         jnp.asarray(n_ex), jax.random.PRNGKey(0), cg, cc,
+        jnp.arange(2, dtype=jnp.int32),
     )
     assert np.isfinite(float(m.train_loss))
     for leaf in jax.tree.leaves(cg2):
@@ -206,6 +231,7 @@ def test_non_participant_keeps_control_variate():
     _, _, _, new_cc, _ = fn(
         params, init(params), x, y, jnp.asarray(idx), jnp.asarray(mask),
         jnp.asarray(n_drop), jax.random.PRNGKey(1), cg, cc,
+        jnp.arange(8, dtype=jnp.int32),
     )
     jax.tree.map(
         lambda new, old: np.testing.assert_array_equal(
@@ -236,7 +262,10 @@ def test_scaffold_e2e_c_mean_invariant(tmp_path):
     exp = Experiment(cfg, echo=False)
     state = exp.fit()
     assert exp.scaffold
-    c_mean = jax.tree.map(lambda a: a.mean(0), state["c_clients"])
+    n = cfg.data.num_clients  # ignore lane-pad rows (always zero)
+    c_mean = jax.tree.map(
+        lambda a: np.asarray(a)[:n].mean(0), state["c_clients"]
+    )
     jax.tree.map(
         lambda cg, cm: np.testing.assert_allclose(
             np.asarray(cg), np.asarray(cm), rtol=1e-4, atol=1e-6
