@@ -98,7 +98,7 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
 
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=False, feddyn=False, client_dp=0.0,
-                         downlink=""):
+                         downlink="", secagg_quant_step=0.0):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -135,6 +135,20 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
             # corrupting the mod-2^32 aggregate
             raise ValueError(
                 "secure aggregation requires clip_delta_norm > 0"
+            )
+        if secagg_quant_step > 0 and clip_delta_norm / secagg_quant_step >= 2**24:
+            # f32 integer-exactness floor for the quantizer, checked
+            # here so DIRECT engine callers get it too; this covers the
+            # uniform-weight case exactly — under example weights the
+            # driver's resolved-cap check (round_driver.
+            # _check_secagg_bounds) is the authoritative, tighter bound
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "secagg clip/quant_step = %.3g >= 2^24: f32 rounding in "
+                "the fixed-point quantizer can lose integer exactness "
+                "for clients near the clip bound",
+                clip_delta_norm / secagg_quant_step,
             )
     if client_dp > 0.0:
         # mirror config.validate(): the sensitivity analysis holds for
@@ -390,7 +404,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     """
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
-                         client_dp=client_dp_noise, downlink=downlink)
+                         client_dp=client_dp_noise, downlink=downlink,
+                         secagg_quant_step=secagg_quant_step)
     if client_dp_noise > 0.0 and agg != "uniform":
         # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
         raise ValueError(
@@ -1009,7 +1024,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
-                         client_dp=client_dp_noise, downlink=downlink)
+                         client_dp=client_dp_noise, downlink=downlink,
+                         secagg_quant_step=secagg_quant_step)
     if client_dp_noise > 0.0 and agg != "uniform":
         raise ValueError(
             "client-level DP requires uniform aggregation weights "

@@ -5,11 +5,16 @@ but the same algorithm/engine/partition structure — so a perf change
 can't silently regress accuracy. Marked ``slow``; run with
 ``pytest -m slow``.
 
-The synthetic CIFAR stand-in (class templates + 30% noise,
-data/core.py) is genuinely learnable, so the accuracy band is
-meaningful: a broken aggregator, a wrong FedAvg weighting, or a
-momentum-gating bug all land far below it, while run-to-run noise
-(fixed seed → deterministic anyway) cannot leave it.
+The task is deliberately NON-SATURATING (VERDICT r3 weak-#3):
+``synthetic_template_weight=0.6`` + Dirichlet α=0.3 was calibrated so
+the fixed-seed run plateaus strictly below 1.0 within the window
+(curve: 0.135 → 0.604 @r12 → 0.93 @r24; the default 0.7-SNR task hits
+1.00 and can hide subtle aggregation drift behind saturation). The
+bands below are sharp enough that the CLASSIC weighting bug — uniform
+client weights where example weights belong — lands at 0.764, well
+below the 0.85 floor; ``test_weighting_bug_trips_band`` proves that
+trip stays demonstrable. Runs are seed-deterministic, so band slack
+covers numeric drift, not sampling noise.
 """
 
 import math
@@ -20,47 +25,78 @@ from colearn_federated_learning_tpu.config import get_named_config
 from colearn_federated_learning_tpu.server.round_driver import Experiment
 
 
-@pytest.mark.slow
-def test_cifar10_fedavg_converges(tmp_path):
+def _reduced_cfg(tmp_path):
     cfg = get_named_config("cifar10_fedavg_100")
     cfg.apply_overrides({
         # reduced scale; structure (dirichlet non-IID, sharded engine,
         # ResNet family, cohort < clients) untouched
         "data.num_clients": 32,
         "data.synthetic_train_size": 2048,
-        "data.synthetic_test_size": 256,
+        "data.synthetic_test_size": 512,
         "data.max_examples_per_client": 64,
+        "data.dirichlet_alpha": 0.3,
+        "data.synthetic_template_weight": 0.6,
         "model.kwargs.width": 8,
-        "server.num_rounds": 20,
+        "server.num_rounds": 24,
         "server.cohort_size": 8,
         "server.eval_every": 4,
         "client.batch_size": 32,
         "run.out_dir": str(tmp_path),
         "run.compute_dtype": "float32",
-        "run.local_param_dtype": "",  # pure-f32 path, as documented above
-        "run.metrics_flush_every": 5,
+        "run.local_param_dtype": "",  # pure-f32 path
+        "run.metrics_flush_every": 4,
     })
-    cfg.validate()
-    exp = Experiment(cfg, echo=False)
+    return cfg.validate()
+
+
+@pytest.mark.slow
+def test_cifar10_fedavg_converges(tmp_path):
+    exp = Experiment(_reduced_cfg(tmp_path), echo=False)
     state = exp.fit()
 
     ev = exp.evaluate(state["params"])
     assert math.isfinite(ev["eval_loss"])
-    # Band calibrated on the fixed seed-0 run (see BASELINE.md convergence
-    # curve): final acc ~0.97 on the 10-class synthetic task; 0.85 leaves
-    # room for numeric drift while catching any real learning regression
-    # (chance = 0.10; a broken aggregator plateaus < 0.3).
-    assert ev["eval_acc"] >= 0.85, ev
+    # Final band [0.85, 0.99], calibrated on the fixed seed-0 run
+    # (0.930): the floor catches real learning regressions including
+    # the uniform-weights bug (0.764); the CEILING asserts the task
+    # stayed non-saturating — an run that hits 1.0 means the difficulty
+    # calibration silently broke and the band lost its sensitivity.
+    assert 0.85 <= ev["eval_acc"] <= 0.99, ev
 
-    # the per-round eval curve must be monotone-ish: last eval better
-    # than the first logged one by a wide margin
-    curve = [
-        (rec["round"], rec["eval_acc"])
+    curve = {
+        rec["round"]: rec["eval_acc"]
         for rec in exp.logger.history
         if "eval_acc" in rec
-    ]
-    assert len(curve) >= 3
-    assert curve[-1][1] > curve[0][1] + 0.1, curve
+    }
+    # Mid-curve band (calibrated 0.604 @r12): learning must be underway
+    # at the expected rate mid-run, not just by the end.
+    assert 0.45 <= curve[12] <= 0.75, curve
+    assert curve[24] > curve[4] + 0.3, curve
+
+
+@pytest.mark.slow
+def test_weighting_bug_trips_band(tmp_path, monkeypatch):
+    """The band's sensitivity proof (VERDICT r3 next-#4 'Done'
+    criterion): swap example weights for uniform weights — the classic
+    FedAvg aggregation bug — and the SAME config must land below the
+    regression floor (calibrated: 0.764 < 0.85). If this test ever
+    fails, the band has gone numb and needs recalibration."""
+    import colearn_federated_learning_tpu.server.round_driver as rd
+
+    orig = rd.make_sharded_round_fn
+
+    def sabotaged(*args, **kwargs):
+        kwargs["agg"] = "uniform"
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(rd, "make_sharded_round_fn", sabotaged)
+    exp = Experiment(_reduced_cfg(tmp_path), echo=False)
+    state = exp.fit()
+    ev = exp.evaluate(state["params"])
+    assert ev["eval_acc"] < 0.85, (
+        "the uniform-weights bug no longer trips the convergence band — "
+        f"recalibrate (got {ev['eval_acc']})"
+    )
 
 
 @pytest.mark.slow

@@ -282,6 +282,32 @@ def test_scaffold_e2e_c_mean_invariant(tmp_path):
     assert np.isfinite(metrics["eval_loss"])
 
 
+def test_scaffold_bf16_state_store(tmp_path):
+    """server.client_state_dtype=bfloat16 halves the state store's HBM
+    budget: the run completes, the store really is bf16, and the
+    trajectory tracks the f32-store run closely (the in-round c math
+    stays f32; only the persistent rows round at scatter-back)."""
+    import jax.numpy as jnp
+
+    def run(path, dtype):
+        cfg = _scaffold_cfg(path, rounds=3)
+        cfg.server.client_state_dtype = dtype
+        exp = Experiment(cfg, echo=False)
+        return exp.fit()
+
+    f32 = run(tmp_path / "f32", "float32")
+    bf16 = run(tmp_path / "bf16", "bfloat16")
+    for leaf in jax.tree.leaves(bf16["c_clients"]):
+        assert leaf.dtype == jnp.bfloat16
+    # bf16 rounding of the persistent state perturbs, not derails
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.05, atol=2e-2
+        ),
+        f32["params"], bf16["params"],
+    )
+
+
 def test_scaffold_resume_reproduces_straight_run(tmp_path):
     def run(path, rounds, resume=False):
         cfg = _scaffold_cfg(path, rounds=rounds)
