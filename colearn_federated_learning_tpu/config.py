@@ -106,6 +106,19 @@ class ServerConfig:
     compression: str = ""
     compression_topk_ratio: float = 0.01
     compression_qsgd_levels: int = 256
+    # Error-feedback compression memory (EF-SGD family — Seide et al.
+    # 2014, Stich et al. 2018): each client keeps a persistent
+    # params-shaped residual eᵢ in the device-resident per-client state
+    # store (same [N, ...] mesh-sharded plumbing as scaffold); per round
+    # the upload is C(Δᵢ + eᵢ) and eᵢ⁺ = Δᵢ + eᵢ − C(Δᵢ + eᵢ), which
+    # de-biases sparse compressors (every coordinate top-k drops is
+    # retried until it ships). Requires `compression`; incompatible with
+    # stateful algorithms (one store per run), robust aggregators
+    # (history-dependent uploads have no order-statistic semantics),
+    # secure_aggregation and client-level DP (the memory breaks the
+    # per-round upload norm bound their analyses need). HBM budget =
+    # N·|params| at client_state_dtype, sharded over lanes.
+    error_feedback: bool = False
     # Clip each client's delta to this L2 norm (whole-tree) before
     # aggregation — the standard heterogeneity stabilizer (and DP-SGD's
     # clipping step without the noise). 0 = off.
@@ -121,8 +134,21 @@ class ServerConfig:
     # algorithm=feddyn only: the dynamic-regularization coefficient α
     # (both the client proximal pull and the server h-correction scale)
     feddyn_alpha: float = 0.1
-    # scaffold/feddyn only: storage dtype of the device-resident
-    # per-client state store (the [N, ...] stacked cᵢ/gᵢ tree, sharded
+    # algorithm=gossip only (decentralized DFedAvg, parallel/gossip.py):
+    # every client keeps its OWN replica ([N, ...] mesh-sharded tree);
+    # per round all N clients train locally then mix with their ring
+    # neighbours — xᵢ ← (1−2γ)xᵢ + γ(xᵢ₋₁+xᵢ₊₁), a halo exchange whose
+    # cross-chip traffic is 2·|params| per lane per step regardless of
+    # N (vs the centralized psum). γ ∈ (0, 0.5]; 1/3 is the Metropolis
+    # ring weight. topology "full" = complete averaging each step
+    # (equals centralized uniform FedAvg from a consensus start — the
+    # tested oracle). Eval/checkpoint export use the consensus mean.
+    gossip_gamma: float = 1.0 / 3.0
+    gossip_mixing_steps: int = 1
+    gossip_topology: str = "ring"  # ring | full
+    # scaffold/feddyn/error_feedback: storage dtype of the device-
+    # resident per-client state store (the [N, ...] stacked cᵢ/gᵢ/eᵢ
+    # tree, sharded
     # over the mesh's clients axis under run.engine=sharded). The HBM
     # budget is N·|params| at this dtype, divided across lanes.
     # "bfloat16" halves it but rounds the PERSISTENT state at each
@@ -279,7 +305,7 @@ class RunConfig:
 
 
 # the federated algorithms the driver implements (validate() + docs)
-ALGORITHMS = ("fedavg", "fedprox", "scaffold", "feddyn", "fedbuff")
+ALGORITHMS = ("fedavg", "fedprox", "scaffold", "feddyn", "fedbuff", "gossip")
 
 
 @dataclass
@@ -354,6 +380,69 @@ class ExperimentConfig:
                 raise ValueError(
                     "feddyn defines its own server update; set "
                     "server.optimizer=mean and server_lr=1.0"
+                )
+        if self.algorithm == "gossip":
+            if self.run.engine != "sharded":
+                raise ValueError("gossip requires run.engine=sharded")
+            if self.server.cohort_size != self.data.num_clients:
+                # there is no cohort: EVERY client trains and gossips
+                # every round (partial participation enters via
+                # dropout_rate, which zeroes the local phase but keeps
+                # the node relaying — the decentralized semantics)
+                raise ValueError(
+                    "gossip requires server.cohort_size == data.num_clients "
+                    "(all clients train every round)"
+                )
+            if self.server.optimizer != "mean" or self.server.server_lr != 1.0:
+                # there is no server update at all — a configured server
+                # optimizer would be silently ignored, so reject it
+                raise ValueError(
+                    "gossip has no server optimizer; set "
+                    "server.optimizer=mean and server_lr=1.0"
+                )
+            if self.server.sampling != "uniform":
+                raise ValueError(
+                    "gossip schedules all clients every round; "
+                    "server.sampling=weighted is not supported"
+                )
+            if (self.server.aggregator != "weighted_mean"
+                    or self.server.compression
+                    or self.server.downlink_compression
+                    or self.server.secure_aggregation
+                    or self.server.error_feedback
+                    or self.server.dp_client_noise_multiplier > 0.0
+                    or self.server.clip_delta_norm > 0.0):
+                # all of these are server-aggregation concepts; gossip
+                # has no server and no uplink — neighbour messages are
+                # the full replicas
+                raise ValueError(
+                    "gossip is incompatible with server-side aggregation "
+                    "options (aggregator/compression/secagg/client-DP/"
+                    "clip_delta_norm)"
+                )
+            if not 0.0 < self.server.gossip_gamma <= 0.5:
+                raise ValueError(
+                    f"server.gossip_gamma must be in (0, 0.5], "
+                    f"got {self.server.gossip_gamma}"
+                )
+            if self.server.gossip_mixing_steps < 1:
+                raise ValueError("server.gossip_mixing_steps must be >= 1")
+            if self.server.gossip_topology not in ("ring", "full"):
+                raise ValueError(
+                    f"unknown server.gossip_topology "
+                    f"{self.server.gossip_topology!r}"
+                )
+            if self.run.batch_shards > 1:
+                raise ValueError("gossip is incompatible with run.batch_shards")
+            if self.data.placement != "hbm":
+                raise ValueError("gossip requires data.placement=hbm")
+            if self.client.lr_decay != 1.0:
+                # the gossip engine has no server round counter to
+                # derive the decay schedule from — a configured decay
+                # would be silently ignored, so reject it (same
+                # principle as the server-optimizer rejection above)
+                raise ValueError(
+                    "gossip does not support client.lr_decay"
                 )
         if self.algorithm == "fedbuff":
             if self.run.engine != "sharded":
@@ -455,6 +544,44 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown server.compression {self.server.compression!r}"
             )
+        if self.server.error_feedback:
+            if not self.server.compression:
+                # the memory accumulates what the compressor dropped;
+                # with no compressor it is identically zero
+                raise ValueError(
+                    "server.error_feedback requires server.compression"
+                )
+            if self.algorithm in ("scaffold", "feddyn", "fedbuff"):
+                # scaffold/feddyn own the per-client store (and reject
+                # compression outright); fedbuff's async engine has no
+                # cohort-synchronous store to scatter into
+                raise ValueError(
+                    f"server.error_feedback is incompatible with "
+                    f"algorithm={self.algorithm!r}"
+                )
+            if self.server.aggregator != "weighted_mean":
+                # EF uploads carry past rounds' residuals — messages of
+                # mixed effective timescales with unbounded per-client
+                # hidden state; coordinate-wise order statistics over
+                # them have no robustness interpretation
+                raise ValueError(
+                    "server.error_feedback is incompatible with robust "
+                    "server.aggregator"
+                )
+            if self.server.secure_aggregation:
+                # secagg's int32 fixed-point range analysis needs the
+                # per-round clip bound; C(Δ+e) is not norm-bounded
+                raise ValueError(
+                    "server.error_feedback is incompatible with "
+                    "server.secure_aggregation"
+                )
+            if self.server.dp_client_noise_multiplier > 0.0:
+                # same bound: the DP sensitivity is the clipped delta
+                # norm, which the memory term escapes
+                raise ValueError(
+                    "server.error_feedback is incompatible with "
+                    "client-level DP"
+                )
         if not 0.0 < self.server.compression_topk_ratio <= 1.0:
             raise ValueError(
                 f"server.compression_topk_ratio must be in (0, 1], "

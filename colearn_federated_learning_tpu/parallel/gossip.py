@@ -1,0 +1,192 @@
+"""Decentralized (serverless) federated learning — gossip averaging.
+
+DFedAvg / consensus-SGD (Lian et al. 2017 "Can Decentralized Algorithms
+Outperform Centralized?"; Koloskova et al. 2020): there is NO server.
+Every client keeps its OWN model replica; each round every client trains
+locally from its own replica, then mixes with its graph neighbours
+through a doubly-stochastic gossip matrix W:
+
+    xᵢ ← Σⱼ Wᵢⱼ · xⱼ(after local training)
+
+The TPU-native mapping (spec frame: SURVEY.md §2 C6/C8 — the
+aggregation/communication rows; the reference mount is empty so the
+citation points at the spec): replicas live as ONE ``[N, ...]`` stacked
+tree, mesh-sharded over the ``clients`` axis — each lane owns a
+contiguous arc of the ring. Ring mixing is then a **halo exchange**:
+only each lane's two boundary rows cross the ICI (two ``ppermute``s of
+one params-sized message each, independent of N), while the interior
+rows mix with an in-lane shift. Per mixing step the cross-chip traffic
+is 2·|params| per lane — compare centralized FedAvg's full psum tree —
+which is exactly why gossip methods exist: O(degree) neighbour traffic
+instead of all-reduce.
+
+Topologies:
+
+- ``ring``: W = Metropolis ring weights ``xᵢ ← (1−2γ)xᵢ + γ(xᵢ₋₁ +
+  xᵢ₊₁)`` (doubly stochastic for any γ; contraction for 0 < γ ≤ 1/2;
+  γ = 1/3 is the Metropolis choice). Consensus error contracts by the
+  spectral gap 1 − λ₂(W), λ₂ = 1 − 2γ(1 − cos 2π/N).
+- ``full``: W = (1/N)·11ᵀ — complete averaging each mixing step. One
+  mixing step from a consensus start is EXACTLY centralized FedAvg
+  with uniform weights (the parity oracle the tests pin).
+
+Mixing preserves the replica mean exactly (W doubly stochastic), so
+the consensus mean ``x̄`` — which the round fn also returns, for
+evaluation/checkpoint export — follows the averaged-SGD trajectory.
+
+Participation: a client whose ``n_ex`` is 0 (dropout upstream zeroing)
+trains zero valid steps — its local phase is an exact no-op — but still
+gossips, which is how an idle node in a real decentralized system
+behaves (it keeps relaying its current model).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.client.trainer import make_local_train_fn
+from colearn_federated_learning_tpu.parallel.mesh import CLIENT_AXIS, has_batch_axis
+from jax.sharding import PartitionSpec as P
+
+
+class GossipMetrics(NamedTuple):
+    train_loss: jnp.ndarray
+    examples: jnp.ndarray
+    # mean over clients of ‖xᵢ − x̄‖² (post-mixing), summed over leaves —
+    # THE health metric of a decentralized run (should contract toward
+    # the noise floor set by data heterogeneity × lr)
+    consensus_dist: jnp.ndarray
+
+
+def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
+                         num_clients: int, gamma: float = 1.0 / 3.0,
+                         mixing_steps: int = 1, topology: str = "ring",
+                         donate: bool = True, local_dtype=None,
+                         scan_unroll: int = 1):
+    """Build the jitted one-program gossip round.
+
+    Signature of the returned fn::
+
+        (replicas [N, ...] client-sharded, train_x, train_y,
+         idx [N,steps,batch], mask [N,steps,batch], n_ex [N], rng)
+        → (new_replicas, mean_params, GossipMetrics)
+
+    ``num_clients`` must divide evenly over the mesh's client lanes
+    (every client trains every round — there are no pad rows to hide).
+    """
+    if topology not in ("ring", "full"):
+        raise ValueError(f"unknown gossip topology {topology!r}")
+    if not 0.0 < gamma <= 0.5:
+        # γ > 1/2 makes the ring weights non-contractive (negative
+        # self-weight); γ ≤ 0 is no mixing at all
+        raise ValueError(f"gossip gamma must be in (0, 0.5], got {gamma}")
+    if mixing_steps < 1:
+        raise ValueError(f"mixing_steps must be >= 1, got {mixing_steps}")
+    if has_batch_axis(mesh):
+        raise ValueError("gossip does not support a batch axis (yet)")
+    n_lanes = mesh.shape[CLIENT_AXIS]
+    if num_clients % n_lanes != 0:
+        raise ValueError(
+            f"num_clients {num_clients} not divisible by {n_lanes} lanes "
+            f"(every client trains every round — no pad rows)"
+        )
+    rows = num_clients // n_lanes
+    local_train = make_local_train_fn(
+        model, client_cfg, dp_cfg, task, local_dtype=local_dtype,
+        scan_unroll=scan_unroll,
+    )
+    # the ring is the global client order: lane l owns rows
+    # [l·rows, (l+1)·rows); forward neighbour of the lane's last row is
+    # the NEXT lane's first row
+    fwd = [(i, (i + 1) % n_lanes) for i in range(n_lanes)]
+    bwd = [(i, (i - 1) % n_lanes) for i in range(n_lanes)]
+
+    def lane_fn(replicas, train_x, train_y, idx, mask, n_ex, keys):
+        # --- local phase: each row trains from ITS OWN params ---------
+        def per_row(_, inp):
+            r_params, r_idx, r_mask, r_key = inp
+            w, m = local_train(r_params, train_x, train_y, r_idx, r_mask, r_key)
+            # replicas stay at the storage dtype across rounds even when
+            # local training runs bf16
+            w = jax.tree.map(
+                lambda a, p: a.astype(p.dtype), w, r_params
+            )
+            return 0.0, (w, m.loss)
+
+        _, (trained, losses) = jax.lax.scan(
+            per_row, 0.0, (replicas, idx, mask, keys)
+        )
+
+        # --- gossip phase: mixing_steps sweeps of W -------------------
+        def mix_ring(a):
+            # a: [rows, ...] — this lane's arc. Halo exchange: the
+            # previous lane's LAST row and the next lane's FIRST row.
+            prev_last = jax.lax.ppermute(a[-1], CLIENT_AXIS, fwd)
+            next_first = jax.lax.ppermute(a[0], CLIENT_AXIS, bwd)
+            up = jnp.concatenate([prev_last[None], a[:-1]], axis=0)   # xᵢ₋₁
+            down = jnp.concatenate([a[1:], next_first[None]], axis=0)  # xᵢ₊₁
+            return ((1.0 - 2.0 * gamma) * a + gamma * (up + down)).astype(a.dtype)
+
+        def mix_full(a):
+            mean = jax.lax.psum(a.sum(0), CLIENT_AXIS) / float(num_clients)
+            return jnp.broadcast_to(mean[None], a.shape).astype(a.dtype)
+
+        mix = mix_ring if topology == "ring" else mix_full
+        mixed = trained
+        for _ in range(mixing_steps):
+            mixed = jax.tree.map(mix, mixed)
+
+        # --- consensus diagnostics + the mean for eval ----------------
+        mean_params = jax.tree.map(
+            lambda a: jax.lax.psum(a.sum(0), CLIENT_AXIS) / float(num_clients),
+            mixed,
+        )
+        dist = sum(
+            jax.lax.psum(
+                jnp.sum((a.astype(jnp.float32)
+                         - m[None].astype(jnp.float32)) ** 2),
+                CLIENT_AXIS,
+            )
+            for a, m in zip(jax.tree.leaves(mixed), jax.tree.leaves(mean_params))
+        ) / float(num_clients)
+        w = n_ex.astype(jnp.float32)
+        w_sum = jax.lax.psum(w.sum(), CLIENT_AXIS)
+        l_sum = jax.lax.psum((w * losses).sum(), CLIENT_AXIS)
+        denom = jnp.where(w_sum > 0, w_sum, 1.0)
+        return mixed, mean_params, {
+            "loss": l_sum / denom,
+            "n": w_sum,
+            "consensus": dist,
+        }
+
+    sharded_lane = jax.shard_map(
+        lane_fn,
+        mesh=mesh,
+        in_specs=(P(CLIENT_AXIS), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                  P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        out_specs=(P(CLIENT_AXIS), P(), {"loss": P(), "n": P(),
+                                         "consensus": P()}),
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def round_fn(replicas, train_x, train_y, idx, mask, n_ex, rng):
+        for leaf in jax.tree.leaves(replicas):
+            if leaf.shape[0] != num_clients:
+                raise ValueError(
+                    f"replicas leading dim {leaf.shape[0]} != num_clients "
+                    f"{num_clients}"
+                )
+            break
+        keys = jax.random.split(rng, idx.shape[0])
+        mixed, mean_params, out = sharded_lane(
+            replicas, train_x, train_y, idx, mask, n_ex, keys
+        )
+        return mixed, mean_params, GossipMetrics(
+            out["loss"], out["n"], out["consensus"]
+        )
+
+    return round_fn

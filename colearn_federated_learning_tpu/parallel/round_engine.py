@@ -98,7 +98,8 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
 
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=False, feddyn=False, client_dp=0.0,
-                         downlink="", secagg_quant_step=0.0):
+                         downlink="", secagg_quant_step=0.0,
+                         error_feedback=False):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -165,6 +166,36 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
         raise ValueError(
             "downlink compression supports fedavg/fedprox only"
         )
+    if error_feedback:
+        if not compression:
+            # EF's whole job is to accumulate what the compressor
+            # dropped; without a compressor the memory is identically 0
+            raise ValueError("error_feedback requires compression")
+        if scaffold or feddyn:
+            # one per-client state store per run — the control-variate
+            # algorithms already own it, and their validate() rules
+            # reject compression anyway
+            raise ValueError(
+                "error_feedback is incompatible with stateful algorithms"
+            )
+        if robust:
+            # EF uploads are history-dependent (this round's message
+            # includes PAST rounds' residuals), so the cohort's messages
+            # mix different effective timescales — coordinate-wise order
+            # statistics over them have no robustness interpretation,
+            # and a Byzantine client's memory is unbounded hidden state
+            raise ValueError(
+                "error_feedback is incompatible with robust aggregators"
+            )
+        if secagg or client_dp > 0.0:
+            # both rely on a per-round norm bound on the upload
+            # (clip_delta_norm); EF uploads C(delta + e) where the
+            # memory e is NOT norm-bounded across rounds, so the
+            # fixed-point range / DP sensitivity analyses don't hold
+            raise ValueError(
+                "error_feedback breaks the per-round upload norm bound "
+                "secure aggregation / client-level DP require"
+            )
 
 
 # fold constant deriving the secure-aggregation mask key from the round
@@ -322,7 +353,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           secagg_quant_step: float = 1e-4,
                           client_dp_noise: float = 0.0,
                           downlink: str = "",
-                          downlink_levels: int = 256):
+                          downlink_levels: int = 256,
+                          error_feedback: bool = False):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -393,6 +425,22 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     Costs K× the aggregation memory/traffic of the psum path (inherent:
     order statistics need all K values).
 
+    ``error_feedback`` activates EF compression memory (the EF-SGD /
+    EF21 family, Seide et al. 2014; Stich et al. 2018; Richtárik et al.
+    2021) on the SAME device-resident per-client store as scaffold:
+    each client keeps a params-shaped residual ``eᵢ``; per round the
+    participant uploads ``C(Δᵢ + eᵢ)`` and keeps ``eᵢ⁺ = Δᵢ + eᵢ −
+    C(Δᵢ + eᵢ)`` (non-participants keep ``eᵢ``), which turns the BIASED
+    top-k operator into an asymptotically-unbiased one — every dropped
+    coordinate is retried until it ships. The round fn takes two extra
+    trailing inputs (``e_clients`` — the ``[N_pad, ...]`` store,
+    mesh-sharded over ``clients`` — and ``cohort``) and returns
+    ``(params, opt_state, new_e_clients, metrics)``; gather/scatter
+    run in-program exactly like scaffold's (zero host sync,
+    multi-host capable). Requires ``compression``; incompatible with
+    stateful algorithms (store conflict), robust aggregation, secagg,
+    and client-level DP (see ``_check_engine_compat``).
+
     ``feddyn_alpha`` > 0 activates FedDyn (Acar et al. 2021) on the
     SAME stateful plumbing as scaffold (mutually exclusive): the
     per-client state gᵢ enters as the gradient correction ``−gᵢ``, the
@@ -405,7 +453,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
-                         secagg_quant_step=secagg_quant_step)
+                         secagg_quant_step=secagg_quant_step,
+                         error_feedback=error_feedback)
     if client_dp_noise > 0.0 and agg != "uniform":
         # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
         raise ValueError(
@@ -442,8 +491,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     stateful = scaffold or feddyn
-    if stateful and num_clients <= 0:
-        raise ValueError("stateful algorithms require num_clients")
+    # use_store: anything that rides the device-resident [N_pad, ...]
+    # per-client store (stateful algorithms carry c_global + the dc psum
+    # on top of it; error feedback only the store itself)
+    use_store = stateful or error_feedback
+    if use_store and num_clients <= 0:
+        raise ValueError("per-client state requires num_clients")
     if aggregator not in ("weighted_mean", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
@@ -470,16 +523,20 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         rest = list(rest)
         lr_scale = rest.pop(0) if use_decay else None
         c_global, c_cohort, c_all, state_pos = None, None, None, None
-        if stateful:
+        if use_store:
             # Device-resident per-client state (VERDICT r3 missing-#1):
             # c_all is this lane's shard of the FULL [N_pad, ...] state
-            # store. Gather the cohort's rows in-program: each lane
-            # `take`s the rows its shard owns (OOB positions fill 0),
-            # and ONE psum superposes the lanes — every row is owned by
-            # exactly one lane, so the sum is exact even in bf16. The
-            # lane then slices its own K/L chunk of the replicated
-            # cohort state and upcasts to f32 for the c math.
-            c_global, c_all, cohort_ids = rest.pop(0), rest.pop(0), rest.pop(0)
+            # store (scaffold/feddyn control variates, or the EF
+            # compression residuals). Gather the cohort's rows
+            # in-program: each lane `take`s the rows its shard owns (OOB
+            # positions fill 0), and ONE psum superposes the lanes —
+            # every row is owned by exactly one lane, so the sum is
+            # exact even in bf16. The lane then slices its own K/L chunk
+            # of the replicated cohort state and upcasts to f32 for the
+            # state math.
+            if stateful:
+                c_global = rest.pop(0)
+            c_all, cohort_ids = rest.pop(0), rest.pop(0)
             lane = jax.lax.axis_index(CLIENT_AXIS)
             rows = jax.tree.leaves(c_all)[0].shape[0]  # N_pad / lanes
             state_pos = cohort_ids - lane * rows  # [K]; OOB = not owned
@@ -518,7 +575,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             c_global = _pcast_varying(c_global)
 
         def per_block(acc, inp):
-            if stateful:
+            b_c = None
+            if error_feedback:
+                # EF residual rows ride the store slot; training itself
+                # is plain (the memory only touches the upload)
+                b_idx, b_mask, b_n, b_keys, b_c = inp
+                extra = () if lr_scale is None else (lr_scale,)
+                w_b, m_b = jax.vmap(
+                    local_train,
+                    in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
+                )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
+            elif stateful:
                 b_idx, b_mask, b_n, b_keys, b_c = inp
                 if scaffold:
                     # SCAFFOLD correction (c − cᵢ), constant over the
@@ -556,7 +623,29 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             )
             if clip_delta_norm > 0.0:
                 delta_b = _clip_block(delta_b, clip_delta_norm)
-            if compress is not None:
+            if error_feedback:
+                # EF memory: the wire message is C(Δᵢ + eᵢ); the
+                # residual of that SAME quantity becomes the new eᵢ.
+                # Non-participants (dropout: Δᵢ = 0, weight 0) keep eᵢ
+                # bit-identical — their C(eᵢ) never ships (zero weight
+                # in the aggregation contraction below).
+                part_b = (b_n > 0).astype(jnp.float32)
+
+                def _bshape(p, d):
+                    return p.reshape((d.shape[0],) + (1,) * (d.ndim - 1))
+
+                acc_b = jax.tree.map(
+                    lambda d, e: d + e.astype(jnp.float32), delta_b, b_c
+                )
+                comp_b = compress(acc_b, b_keys)
+                ys["c"] = jax.tree.map(
+                    lambda a, cp, e: jnp.where(
+                        _bshape(part_b, a) > 0, a - cp, e.astype(jnp.float32)
+                    ),
+                    acc_b, comp_b, b_c,
+                )
+                delta_b = comp_b
+            elif compress is not None:
                 delta_b = compress(delta_b, b_keys)
             if robust:
                 # robust modes need every client's delta individually —
@@ -610,7 +699,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     l_acc + (b_w * m_b.loss).sum(), dc_acc), ys
 
         n_blocks = idx.shape[0] // width
-        scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if stateful else ())
+        scan_in = (idx, mask, n_ex, keys) + ((c_cohort,) if use_store else ())
         if secagg:
             scan_in += (slots_l,)
         blocked = jax.tree.map(
@@ -688,6 +777,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )
         if stateful:
             out["dc_sum"] = jax.lax.psum(dc_sum, CLIENT_AXIS)
+        if use_store:
             # scatter the cohort's updated rows back into the sharded
             # state store, in-program: all lanes see the full [K, ...]
             # new state (all_gather in cohort order), then each lane
@@ -720,6 +810,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # c_global (replicated), c_clients (state store, sharded on its
         # leading N_pad dim), cohort ids (replicated)
         in_specs += (P(), P(CLIENT_AXIS), P())
+    elif error_feedback:
+        # e_clients store (sharded) + cohort ids; no global state
+        in_specs += (P(CLIENT_AXIS), P())
     if secagg:
         in_specs += (P(),)  # replicated mask key; the ring is static
     if client_dp_noise > 0.0:
@@ -731,6 +824,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         out_specs["mean_delta"] = P()
     if stateful:
         out_specs["dc_sum"] = P()
+    if use_store:
         out_specs["c_all"] = P(CLIENT_AXIS)
     sharded_lane = jax.shard_map(
         lane_fn,
@@ -794,6 +888,36 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     params, server_opt_state, _mean_delta(out, n_ex)
                 )
             return (new_params, new_opt_state, new_c_global, out["c_all"],
+                    RoundMetrics(out["loss"], out["n"]))
+
+        return round_fn
+
+    if error_feedback:
+
+        @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
+        def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
+                     n_ex, rng, e_clients, cohort):
+            n_lanes_ = mesh.shape[CLIENT_AXIS]
+            for leaf in jax.tree.leaves(e_clients):
+                if leaf.shape[0] % n_lanes_:
+                    raise ValueError(
+                        f"e_clients leading dim {leaf.shape[0]} must be a "
+                        f"multiple of {n_lanes_} lanes (pad the state "
+                        f"store; pad rows are never addressed)"
+                    )
+                break
+            keys = jax.random.split(rng, idx.shape[0])
+            extra = ()
+            if use_decay:
+                extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            out = sharded_lane(
+                _bcast(params, rng), train_x, train_y, idx, mask, n_ex,
+                keys, *extra, e_clients, cohort.astype(jnp.int32),
+            )
+            new_params, new_opt_state = server_update(
+                params, server_opt_state, out["mean_delta"]
+            )
+            return (new_params, new_opt_state, out["c_all"],
                     RoundMetrics(out["loss"], out["n"]))
 
         return round_fn
@@ -1014,18 +1138,23 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              scan_unroll: int = 1,
                              client_dp_noise: float = 0.0,
                              downlink: str = "",
-                             downlink_levels: int = 256):
+                             downlink_levels: int = 256,
+                             error_feedback: bool = False):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
-    engine is tested against (SURVEY.md §4.3). ``scaffold``, ``feddyn``
-    and ``aggregator`` mirror the sharded engine's signature exactly."""
+    engine is tested against (SURVEY.md §4.3). ``scaffold``, ``feddyn``,
+    ``error_feedback`` and ``aggregator`` mirror the sharded engine's
+    signature exactly (under ``error_feedback`` the round fn takes the
+    cohort's e-rows as ``c_cohort`` — ``c_global`` stays None — and
+    returns ``(params, opt_state, new_e_cohort, metrics)``)."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
-                         secagg_quant_step=secagg_quant_step)
+                         secagg_quant_step=secagg_quant_step,
+                         error_feedback=error_feedback)
     if client_dp_noise > 0.0 and agg != "uniform":
         raise ValueError(
             "client-level DP requires uniform aggregation weights "
@@ -1134,11 +1263,24 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             )
             if clip_delta_norm > 0.0 or compress is not None:
                 # one width-1 block through the SAME operators as the
-                # sharded lane (clip first, then compress the wire format)
+                # sharded lane (clip first, then EF memory, then
+                # compress the wire format)
                 block = jax.tree.map(lambda a: a[None], delta_i)
                 if clip_delta_norm > 0.0:
                     block = _clip_block(block, clip_delta_norm)
-                if compress is not None:
+                if error_feedback:
+                    e_block = jax.tree.map(
+                        lambda a: a[c][None].astype(jnp.float32), c_cohort
+                    )
+                    acc_block = jax.tree.map(jnp.add, block, e_block)
+                    comp_block = compress(acc_block, keys[c][None])
+                    part_c = (jnp.asarray(n_ex[c]) > 0)
+                    new_cs.append(jax.tree.map(
+                        lambda a, cp, e: jnp.where(part_c, a - cp, e)[0],
+                        acc_block, comp_block, e_block,
+                    ))
+                    block = comp_block
+                elif compress is not None:
                     block = compress(block, keys[c][None])
                 delta_i = jax.tree.map(lambda a: a[0], block)
             n_c = jnp.asarray(n_ex[c])
@@ -1223,6 +1365,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             return (new_params, new_opt_state, new_c_global, new_c_cohort,
                     RoundMetrics(mean_loss, n_total))
         new_params, new_opt_state = update(params, server_opt_state, mean_delta)
+        if error_feedback:
+            new_e_cohort = jax.tree.map(lambda *ls: jnp.stack(ls), *new_cs)
+            return (new_params, new_opt_state, new_e_cohort,
+                    RoundMetrics(mean_loss, n_total))
         return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
 
     return round_fn

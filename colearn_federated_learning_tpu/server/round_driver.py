@@ -87,12 +87,24 @@ class Experiment:
         # c_clients carries the per-client gᵢ corrections
         self.feddyn = cfg.algorithm == "feddyn"
         self.stateful = self.scaffold or self.feddyn
+        # Error-feedback compression (ServerConfig.error_feedback) rides
+        # the SAME device-resident store (c_clients carries the eᵢ
+        # residuals) but has no global state — store_state gates the
+        # store plumbing, stateful the c_global/dc machinery
+        self.ef = cfg.server.error_feedback
+        self.store_state = self.stateful or self.ef
         # FedBuff (cfg.algorithm="fedbuff"): the server steps an
         # asynchronous in-flight queue instead of sampling synchronous
         # cohorts — client completions are consumed K at a time, each
         # trained against the stale params version it started from
         # (kept in an on-device history ring), staleness-decayed.
         self.fedbuff = cfg.algorithm == "fedbuff"
+        # Decentralized gossip (cfg.algorithm="gossip", parallel/gossip.py):
+        # no server — every client keeps its own replica in a [N, ...]
+        # mesh-sharded tree; rounds are local-train + ring halo-exchange
+        # mixing. state["params"] tracks the consensus mean (what eval/
+        # checkpoint-export consume); state["replicas"] is the stack.
+        self.gossip = cfg.algorithm == "gossip"
         # secure aggregation (ServerConfig.secure_aggregation): masks
         # ride a STATIC full-cohort ring; the fixed-point range checks
         # run after the aggregation-weight mode is resolved below
@@ -150,7 +162,21 @@ class Experiment:
             else:
                 lanes = mesh_lib.largest_lane_count(cfg.server.cohort_size, avail)
             self.mesh = mesh_lib.build_client_mesh(lanes, batch_shards=batch_shards)
-            if self.fedbuff:
+            if self.gossip:
+                from colearn_federated_learning_tpu.parallel.gossip import (
+                    make_gossip_round_fn,
+                )
+
+                self.round_fn = make_gossip_round_fn(
+                    self.model, cfg.client, cfg.dp, self.task, self.mesh,
+                    num_clients=self.fed.num_clients,
+                    gamma=cfg.server.gossip_gamma,
+                    mixing_steps=cfg.server.gossip_mixing_steps,
+                    topology=cfg.server.gossip_topology,
+                    local_dtype=self._local_dtype(),
+                    scan_unroll=cfg.run.scan_unroll,
+                )
+            elif self.fedbuff:
                 self.round_fn = make_async_round_fn(
                     self.model, cfg.client, cfg.dp, self.task, self.mesh,
                     server_update, buffer_size=cfg.server.cohort_size,
@@ -183,6 +209,7 @@ class Experiment:
                     client_dp_noise=cfg.server.dp_client_noise_multiplier,
                     downlink=cfg.server.downlink_compression,
                     downlink_levels=cfg.server.downlink_qsgd_levels,
+                    error_feedback=self.ef,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -213,6 +240,7 @@ class Experiment:
                 client_dp_noise=cfg.server.dp_client_noise_multiplier,
                 downlink=cfg.server.downlink_compression,
                 downlink_levels=cfg.server.downlink_qsgd_levels,
+                error_feedback=self.ef,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -294,14 +322,14 @@ class Experiment:
         # 0 writes/echoes metrics. Checkpointing stays collective (orbax
         # coordinates its own primary-writer protocol internally).
         self._primary = jax.process_index() == 0
-        if (self.stateful and jax.process_count() > 1
+        if (self.store_state and jax.process_count() > 1
                 and cfg.run.engine != "sharded"):
             # only the sequential oracle still host-scatters per-client
             # state (device_get of non-addressable shards is impossible
             # in a multi-controller run); the sharded engine keeps the
             # store device-resident and is fully multi-host capable
             raise NotImplementedError(
-                "scaffold/feddyn under multi-host requires "
+                "scaffold/feddyn/error_feedback under multi-host requires "
                 "run.engine=sharded (the sequential oracle's host-"
                 "resident state scatter cannot cross processes)"
             )
@@ -422,18 +450,30 @@ class Experiment:
             "round": 0,
             "rng_key": run_rng,
         }
-        if self.stateful:
-            # scaffold: c (replicated) + all-clients cᵢ; feddyn: h + gᵢ —
-            # same shapes. The template is host numpy (cheap: zeros are
-            # lazily allocated); _place_state moves it to the device
+        if self.store_state:
+            # scaffold: c (replicated) + all-clients cᵢ; feddyn: h + gᵢ
+            # — same shapes; error feedback: per-client eᵢ residuals
+            # only (no global). The template is host numpy (cheap: zeros
+            # are lazily allocated); _place_state moves it to the device
             # store (sharded engine) or keeps it writable numpy
             # (sequential oracle). Rows are lane-padded under the
             # sharded engine; pad rows are never addressed.
-            state["c_global"] = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
-            )
+            if self.stateful:
+                state["c_global"] = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
             state["c_clients"] = jax.tree.map(
                 lambda p: np.zeros((self._state_rows,) + p.shape, np.float32),
+                params,
+            )
+        if self.gossip:
+            # every client starts at the same point (the standard
+            # consensus init); the stack is host numpy until
+            # _place_state shards it over the mesh
+            state["replicas"] = jax.tree.map(
+                lambda p: np.broadcast_to(
+                    np.asarray(p)[None], (self.fed.num_clients,) + p.shape
+                ).copy(),
                 params,
             )
         if self.fedbuff:
@@ -479,7 +519,7 @@ class Experiment:
             state["server_opt_state"] = self._put_data(state["server_opt_state"])
             if self.stateful:
                 state["c_global"] = self._put_data(state["c_global"])
-        if self.stateful:
+        if self.store_state:
             if self._data_sharding is not None:
                 # device-resident store: client-sharded over the mesh at
                 # the configured storage dtype; HBM budget is
@@ -519,6 +559,17 @@ class Experiment:
                     else np.array(a, dtype=np.float32, copy=True),
                     state["c_clients"],
                 )
+        if self.gossip:
+            # warm-start replicas from a previous fit() on this
+            # Experiment are already device-resident + client-sharded;
+            # fresh init / orbax restore arrive as host numpy and only
+            # each chip's shard is uploaded (same rationale as the
+            # scaffold store placement above)
+            state["replicas"] = jax.tree.map(
+                lambda a: a if isinstance(a, jax.Array)
+                else self._put(np.asarray(a), self._client_sharding),
+                state["replicas"],
+            )
         if self.fedbuff:
             if self._data_sharding is not None:
                 state["history"] = self._put_data(state["history"])
@@ -534,7 +585,12 @@ class Experiment:
         """All host-side work for one round: sampling, index construction,
         dropout weights, and (stream mode) the slab gather. Pure in
         (seed, round) — safe to run ahead on a worker thread."""
-        cohort = self.sampler.sample(round_idx)
+        if self.gossip:
+            # no sampling: row i of the round tensors IS client i (the
+            # ring order is the client-id order, every round)
+            cohort = np.arange(self.fed.num_clients, dtype=np.int64)
+        else:
+            cohort = self.sampler.sample(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
         if self._native is not None:
             self._native.submit(round_idx, cohort)  # no-op if prefetched
@@ -575,6 +631,14 @@ class Experiment:
             if not participate.any():
                 participate[host_rng.integers(k)] = True
             n_ex = n_ex * participate.astype(np.float32)
+            if self.gossip:
+                # gossip has no aggregation weight for n_ex to zero —
+                # the local phase is gated by the step mask, so a
+                # dropped client must have its mask zeroed too (it then
+                # trains zero valid steps and only RELAYS its replica,
+                # the decentralized dropout semantics)
+                mask = mask.copy()
+                mask[~participate] = 0.0
         return mask, n_ex
 
     def _round_inputs(self, round_idx: int):
@@ -708,7 +772,26 @@ class Experiment:
             return self._run_async_round(state, round_idx)
         cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
-        if self.stateful:
+        if self.gossip:
+            replicas, mean_params, metrics = self.round_fn(
+                state["replicas"], train_x, train_y, idx, mask, n_ex, rng,
+            )
+            return {
+                "params": mean_params,
+                "server_opt_state": state["server_opt_state"],
+                "round": round_idx + 1,
+                "rng_key": state["rng_key"],
+                "replicas": replicas,
+                "_metrics": metrics,
+            }
+        if self.store_state:
+            # scaffold/feddyn carry c_global on top of the per-client
+            # store; error feedback is store-only. One branch covers
+            # both — the round fn's extra leading state arg (c_global)
+            # and return slot exist exactly when self.stateful.
+            common = (state["params"], state["server_opt_state"],
+                      train_x, train_y, idx, mask, n_ex, rng)
+            glob = (state["c_global"],) if self.stateful else ()
             if self._data_sharding is not None:
                 # device-resident store: the cohort gather/scatter runs
                 # INSIDE the round program (donated, so the store is
@@ -717,22 +800,20 @@ class Experiment:
                     jnp.asarray(np.asarray(cohort, np.int32)),
                     self._data_sharding,
                 )
-                params, opt_state, c_global, c_clients, metrics = self.round_fn(
-                    state["params"], state["server_opt_state"],
-                    train_x, train_y, idx, mask, n_ex, rng,
-                    state["c_global"], state["c_clients"], cohort_dev,
+                out = self.round_fn(
+                    *common, *glob, state["c_clients"], cohort_dev,
                 )
+                *head, c_clients, metrics = out
             else:
                 # sequential oracle: host-resident numpy store with an
                 # explicit per-round gather/scatter
                 c_cohort = jax.tree.map(
                     lambda a: jnp.asarray(a[cohort]), state["c_clients"]
                 )
-                params, opt_state, c_global, new_c_cohort, metrics = self.round_fn(
-                    state["params"], state["server_opt_state"],
-                    train_x, train_y, idx, mask, n_ex, rng,
-                    state["c_global"], c_cohort,
+                out = self.round_fn(
+                    *common, *(glob or (None,)), c_cohort,
                 )
+                *head, new_c_cohort, metrics = out
                 fetched = jax.device_get(new_c_cohort)
                 rows = np.asarray(cohort)
                 jax.tree.map(
@@ -740,15 +821,17 @@ class Experiment:
                     state["c_clients"], fetched,
                 )
                 c_clients = state["c_clients"]
-            return {
-                "params": params,
-                "server_opt_state": opt_state,
+            new_state = {
+                "params": head[0],
+                "server_opt_state": head[1],
                 "round": round_idx + 1,
                 "rng_key": state["rng_key"],
-                "c_global": c_global,
                 "c_clients": c_clients,
                 "_metrics": metrics,
             }
+            if self.stateful:
+                new_state["c_global"] = head[2]
+            return new_state
         params, opt_state, metrics = self.round_fn(
             state["params"], state["server_opt_state"],
             train_x, train_y, idx, mask, n_ex, rng,
@@ -917,6 +1000,9 @@ class Experiment:
                     record["mean_staleness"] = round(
                         self._async_stats.pop(ridx), 3
                     )
+                if hasattr(m, "consensus_dist"):
+                    # decentralized health: Σ‖xᵢ−x̄‖²/N after mixing
+                    record["consensus_dist"] = float(m.consensus_dist)
                 if ridx == pending[-1][0]:
                     record["rounds_per_sec"] = round(rounds_per_sec, 4)
                     record["client_updates_per_sec_per_chip"] = round(updates_per_sec, 4)
