@@ -49,3 +49,4 @@ from colearn_federated_learning_tpu.models import resnet  # noqa: E402,F401
 from colearn_federated_learning_tpu.models import mobilenet  # noqa: E402,F401
 from colearn_federated_learning_tpu.models import bert  # noqa: E402,F401
 from colearn_federated_learning_tpu.models import vit  # noqa: E402,F401
+from colearn_federated_learning_tpu.models import lstm  # noqa: E402,F401

@@ -1,0 +1,85 @@
+"""Stacked character LSTM — the canonical LEAF Shakespeare model.
+
+The LEAF benchmark (Caldas et al. 2018, "LEAF: A Benchmark for
+Federated Settings") models Shakespeare next-character prediction with
+an embedding + 2-layer stacked LSTM (hidden 256); the spec frame's
+Shakespeare row (BASELINE.json:10, SURVEY.md §2 C9 — the reference
+mount is empty, so citations point at the spec) is covered by
+``bert_tiny`` as the judged config, and this module adds the classic
+recurrent architecture so LEAF users find the model they expect.
+
+TPU-idiomatic: the time recurrence is ``flax.linen.RNN`` —
+``nn.scan``-lifted ``OptimizedLSTMCell`` (fused gate matmuls: one
+[E+H → 4H] contraction per step hits the MXU once instead of four
+sliver matmuls), compiled as a single ``lax.scan`` with static length.
+An LSTM is inherently causal, so next-token prediction needs no mask;
+sequence parallelism does NOT apply to recurrence (the carry is a
+serial dependency — ring/Ulysses attention models are the long-context
+path, SURVEY.md §5), which is why this model takes no ``pos_offset``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models import _INPUT_SPECS, model_registry
+
+
+class StackedCharLSTM(nn.Module):
+    vocab_size: int = 90
+    embed_dim: int = 8
+    hidden: int = 256
+    layers: int = 2
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        # tokens: [B, T] int32 → logits [B, T, V] (next-token prediction)
+        del train  # no dropout in the LEAF reference architecture
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim,
+            embedding_init=nn.initializers.normal(0.02),
+            param_dtype=self.param_dtype,
+        )(tokens).astype(self.compute_dtype)
+        for _ in range(self.layers):
+            # vma discipline: under the engine's shard_map the scan
+            # carry must match the device-VARYING activations, but a
+            # fresh zeros carry is device-invariant and the lifted scan
+            # rejects the mismatch — tie the initial carry to x with an
+            # exact-zero mix (same trick as the engine's _pcast_varying,
+            # expressed without axis names so the model stays
+            # context-free)
+            zero = jnp.zeros(
+                (x.shape[0], self.hidden), self.compute_dtype
+            ) + 0.0 * x[:, 0, :1]
+            x = nn.RNN(
+                nn.OptimizedLSTMCell(
+                    self.hidden, dtype=self.compute_dtype,
+                    param_dtype=self.param_dtype,
+                )
+            )(x, initial_carry=(zero, zero))
+        # logits in f32 for stable cross-entropy (zoo-wide invariant)
+        return nn.Dense(
+            self.vocab_size, dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+        )(x).astype(jnp.float32)
+
+
+@model_registry.register("stacked_lstm")
+def _build(num_classes: int = 0, vocab_size: int = 90, seq_len: int = 80,
+           embed_dim: int = 8, hidden: int = 256, layers: int = 2,
+           compute_dtype=jnp.float32, param_dtype=jnp.float32, **_):
+    del num_classes, seq_len  # LM: output dim == vocab_size; length-free
+    return StackedCharLSTM(
+        vocab_size=vocab_size, embed_dim=embed_dim, hidden=hidden,
+        layers=layers, compute_dtype=compute_dtype, param_dtype=param_dtype,
+    )
+
+
+def _lm_spec(vocab_size: int = 90, seq_len: int = 80, **_):
+    return (seq_len,), jnp.int32
+
+
+_INPUT_SPECS["stacked_lstm"] = _lm_spec

@@ -122,23 +122,51 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
         )
 
         # --- gossip phase: mixing_steps sweeps of W -------------------
-        def mix_ring(a):
-            # a: [rows, ...] — this lane's arc. Halo exchange: the
-            # previous lane's LAST row and the next lane's FIRST row.
-            prev_last = jax.lax.ppermute(a[-1], CLIENT_AXIS, fwd)
-            next_first = jax.lax.ppermute(a[0], CLIENT_AXIS, bwd)
-            up = jnp.concatenate([prev_last[None], a[:-1]], axis=0)   # xᵢ₋₁
-            down = jnp.concatenate([a[1:], next_first[None]], axis=0)  # xᵢ₊₁
-            return ((1.0 - 2.0 * gamma) * a + gamma * (up + down)).astype(a.dtype)
+        def sweep_ring(tree):
+            # Halo exchange for the whole tree as TWO collectives: the
+            # lane's boundary rows (every leaf's first/last row) pack
+            # into one flat f32 buffer each, so a sweep is exactly two
+            # params-sized ppermute messages — not two per LEAF, which
+            # would pay collective-launch latency on dozens of
+            # sliver-sized bias/norm leaves.
+            leaves, treedef = jax.tree.flatten(tree)
+            firsts = jnp.concatenate(
+                [l[0].astype(jnp.float32).reshape(-1) for l in leaves]
+            )
+            lasts = jnp.concatenate(
+                [l[-1].astype(jnp.float32).reshape(-1) for l in leaves]
+            )
+            prev_last = jax.lax.ppermute(lasts, CLIENT_AXIS, fwd)
+            next_first = jax.lax.ppermute(firsts, CLIENT_AXIS, bwd)
+            out, off = [], 0
+            for l in leaves:
+                n = 1
+                for d in l.shape[1:]:
+                    n *= d
+                pl = prev_last[off:off + n].reshape(l.shape[1:]).astype(l.dtype)
+                nf = next_first[off:off + n].reshape(l.shape[1:]).astype(l.dtype)
+                off += n
+                up = jnp.concatenate([pl[None], l[:-1]], axis=0)    # xᵢ₋₁
+                down = jnp.concatenate([l[1:], nf[None]], axis=0)   # xᵢ₊₁
+                out.append(
+                    ((1.0 - 2.0 * gamma) * l + gamma * (up + down)).astype(l.dtype)
+                )
+            return jax.tree.unflatten(treedef, out)
 
-        def mix_full(a):
-            mean = jax.lax.psum(a.sum(0), CLIENT_AXIS) / float(num_clients)
-            return jnp.broadcast_to(mean[None], a.shape).astype(a.dtype)
+        def sweep_full(tree):
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(
+                    (jax.lax.psum(a.sum(0), CLIENT_AXIS)
+                     / float(num_clients))[None],
+                    a.shape,
+                ).astype(a.dtype),
+                tree,
+            )
 
-        mix = mix_ring if topology == "ring" else mix_full
+        sweep = sweep_ring if topology == "ring" else sweep_full
         mixed = trained
         for _ in range(mixing_steps):
-            mixed = jax.tree.map(mix, mixed)
+            mixed = sweep(mixed)
 
         # --- consensus diagnostics + the mean for eval ----------------
         mean_params = jax.tree.map(

@@ -30,8 +30,13 @@ def main():
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
     mode = sys.argv[5] if len(sys.argv) > 5 else "fedavg"
+    # 8 global devices regardless of the process count (2 procs × 4,
+    # 4 procs × 2, ...): the mesh shape — and therefore the numerics —
+    # is identical across multiplicities, only the process boundaries
+    # move
     os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={8 // nprocs}"
     )
     import jax
 

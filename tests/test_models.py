@@ -17,6 +17,8 @@ from colearn_federated_learning_tpu.models import build_model, init_params
          (16,), jnp.int32, (2, 16, 90)),
         ("vit_b16", {"num_classes": 10, "image_size": 32}, (32, 32, 3),
          jnp.float32, (2, 10)),
+        ("stacked_lstm", {"num_classes": 0, "vocab_size": 90, "seq_len": 16,
+                          "hidden": 32}, (16,), jnp.int32, (2, 16, 90)),
     ],
 )
 def test_forward_shapes(name, kwargs, in_shape, in_dtype, out_shape):
@@ -52,3 +54,61 @@ def test_bfloat16_compute_dtype():
     params = init_params(model, (32, 32, 3), seed=0)
     out = model.apply({"params": params}, jnp.ones((2, 32, 32, 3)), train=False)
     assert out.dtype == jnp.float32
+
+
+def test_stacked_lstm_trains_in_engine():
+    """The LEAF-canonical recurrent model runs through the real round
+    engine (lm task) and one round reduces the next-token loss on a
+    learnable periodic sequence."""
+    import numpy as np
+
+    from colearn_federated_learning_tpu.config import (
+        ClientConfig,
+        DPConfig,
+        ServerConfig,
+    )
+    from colearn_federated_learning_tpu.data.loader import (
+        RoundShape,
+        make_round_indices,
+    )
+    from colearn_federated_learning_tpu.parallel.mesh import build_client_mesh
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sharded_round_fn,
+    )
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+
+    model = build_model("stacked_lstm", num_classes=0, vocab_size=16,
+                        seq_len=16, hidden=32)
+    params = init_params(model, (16,), seed=0, input_dtype=jnp.int32)
+    rng = np.random.default_rng(0)
+    # periodic text: perfectly learnable next-token structure
+    base = np.arange(256 * 17) % 16
+    x = jnp.asarray(base.reshape(-1, 17)[:, :16].astype(np.int32))[:256]
+    y = jnp.asarray(base.reshape(-1, 17)[:, 1:].astype(np.int32))[:256]
+
+    class _Fed:
+        client_indices = list(np.array_split(np.arange(256), 8))
+
+    idx, mask, n_ex = make_round_indices(
+        _Fed(), list(range(8)), RoundShape(2, 4, 8, 32), rng
+    )
+    # char-LSTM at plain SGD wants a hot lr (measured: lr=2.0 reaches
+    # ~0.8 by round 8 on this task; lr=0.5 barely moves in-window)
+    ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=2.0, momentum=0.0)
+    init, supd = make_server_update_fn(
+        ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+    )
+    mesh = build_client_mesh(8)
+    fn = make_sharded_round_fn(
+        model, ccfg, DPConfig(), "lm", mesh, supd, cohort_size=8,
+        donate=False,
+    )
+    p, s = params, init(params)
+    losses = []
+    for r in range(8):
+        p, s, m = fn(p, s, x, y, jnp.asarray(idx), jnp.asarray(mask),
+                     jnp.asarray(n_ex), jax.random.fold_in(jax.random.PRNGKey(0), r))
+        losses.append(float(m.train_loss))
+    assert losses[-1] < losses[0] * 0.5, losses

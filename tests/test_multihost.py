@@ -28,11 +28,11 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_workers(worker, extra_args=(), timeout=300):
-    """Launch the 2-process cluster, collect stdout, kill on ANY exit
-    path (a hung worker must not leak processes holding the coordinator
-    port for the rest of the CI run). Skips when the host lacks
-    cross-process CPU collectives."""
+def _run_workers(worker, extra_args=(), timeout=300, nprocs=2):
+    """Launch the nprocs-process cluster, collect stdout, kill on ANY
+    exit path (a hung worker must not leak processes holding the
+    coordinator port for the rest of the CI run). Skips when the host
+    lacks cross-process CPU collectives."""
     port = _free_port()
     env = {
         k: v for k, v in os.environ.items()
@@ -40,10 +40,11 @@ def _run_workers(worker, extra_args=(), timeout=300):
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", str(port), *extra_args],
+            [sys.executable, worker, str(pid), str(nprocs), str(port),
+             *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
@@ -215,6 +216,24 @@ def test_two_process_fit_eval_checkpoint_resume(tmp_path):
         if p.name.isdigit()
     )
     assert 4 in ckpts and 6 in ckpts, ckpts
+
+
+def test_four_process_fit(tmp_path):
+    """Scale the multiplicity: the SAME 8-device mesh split over FOUR
+    processes (2 devices each). Every process completes fit + resume
+    and holds identical final params — the numerics can't depend on
+    where the process boundaries fall."""
+    out_dir = str(tmp_path / "runs")
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(out_dir,), timeout=600, nprocs=4,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert [p[1] for p in parsed] == ["6"] * 4, parsed
+    assert all(p[2:] == parsed[0][2:] for p in parsed[1:]), parsed
 
 
 def test_two_process_scaffold_fit(tmp_path):
