@@ -17,6 +17,12 @@ Modes (5th arg, default ``fedavg``):
   queue RNG streams stayed bit-identical across processes.
 - ``stream``   — ``data.placement=stream``: each round's slab is
   gathered host-side per process and fed via ``host_local_array``.
+- ``gossip``   — decentralized: the replica stack is sharded ACROSS
+  processes and the ring halo-exchange ppermutes cross the process
+  boundary every round; checkpoints the sharded stack collectively.
+- ``ef``       — error-feedback compression: the per-client residual
+  store rides scaffold's cross-process store plumbing (no global
+  state).
 
 Run: multihost_fit_worker.py <pid> <nprocs> <port> <out_dir> [mode].
 """
@@ -75,6 +81,18 @@ def main():
             cfg.server.async_max_staleness = 2
         elif mode == "stream":
             cfg.data.placement = "stream"
+        elif mode == "gossip":
+            # replicas sharded ACROSS processes; the halo-exchange
+            # ppermutes cross the process boundary every round
+            cfg.algorithm = "gossip"
+            cfg.server.gossip_mixing_steps = 2
+            cfg.client.local_epochs = 2
+        elif mode == "ef":
+            # the EF residual store rides scaffold's cross-process
+            # store plumbing without a global state
+            cfg.server.compression = "topk"
+            cfg.server.compression_topk_ratio = 0.25
+            cfg.server.error_feedback = True
         return cfg.validate()
 
     # phase 1: fresh 4-round fit with eval + periodic checkpoints

@@ -218,6 +218,41 @@ def test_two_process_fit_eval_checkpoint_resume(tmp_path):
     assert 4 in ckpts and 6 in ckpts, ckpts
 
 
+def test_two_process_gossip_fit(tmp_path):
+    """Decentralized multihost: the replica stack is sharded across the
+    two processes and the ring halo exchange crosses the process
+    boundary every round (mixing 2 sweeps); fit + collective
+    checkpoint/resume complete with identical consensus means."""
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(str(tmp_path / "runs"), "gossip"),
+        timeout=600,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert parsed[0][1] == parsed[1][1] == "6", parsed
+    assert parsed[0][2:] == parsed[1][2:], parsed
+
+
+def test_two_process_ef_fit(tmp_path):
+    """Error-feedback multihost: the per-client residual store rides
+    the cross-process store plumbing (gather psum / scatter all_gather
+    over the process boundary); identical final params on both hosts."""
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(str(tmp_path / "runs"), "ef"),
+        timeout=600,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert parsed[0][1] == parsed[1][1] == "6", parsed
+    assert parsed[0][2:] == parsed[1][2:], parsed
+
+
 def test_four_process_fit(tmp_path):
     """Scale the multiplicity: the SAME 8-device mesh split over FOUR
     processes (2 devices each). Every process completes fit + resume
