@@ -30,6 +30,9 @@ _SHRINK = {
         "data.num_clients": 16,
         "model.kwargs.seq_len": 16,
     },
+    # gossip: the blanket cohort shrink (min(cohort,4)) must keep
+    # cohort == num_clients, so shrink the federation to 4 as well
+    "cifar10_gossip_16": {"data.num_clients": 4, "model.kwargs.width": 16},
     "imagenet_silo_dp": {
         "data.num_clients": 8,
         "server.cohort_size": 8,

@@ -10,7 +10,8 @@ from colearn_federated_learning_tpu.config import (
 
 def test_named_configs_exist():
     # BASELINE.json:7-11 — the five capability configs, plus the
-    # 1000-client north-star scale config (BASELINE.json:5)
+    # 1000-client north-star scale config (BASELINE.json:5) and the
+    # beyond-reference decentralized showcase
     assert list_named_configs() == sorted([
         "mnist_fedavg_2",
         "cifar10_fedavg_100",
@@ -18,6 +19,7 @@ def test_named_configs_exist():
         "femnist_fedprox_500",
         "shakespeare_fedavg",
         "imagenet_silo_dp",
+        "cifar10_gossip_16",
     ])
     for name in list_named_configs():
         cfg = get_named_config(name)
