@@ -417,8 +417,8 @@ class ExperimentConfig:
                 # the full replicas
                 raise ValueError(
                     "gossip is incompatible with server-side aggregation "
-                    "options (aggregator/compression/secagg/client-DP/"
-                    "clip_delta_norm)"
+                    "options (aggregator/compression/downlink_compression/"
+                    "secagg/error_feedback/client-DP/clip_delta_norm)"
                 )
             if not 0.0 < self.server.gossip_gamma <= 0.5:
                 raise ValueError(

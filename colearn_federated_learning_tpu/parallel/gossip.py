@@ -80,6 +80,11 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
     """
     if topology not in ("ring", "full"):
         raise ValueError(f"unknown gossip topology {topology!r}")
+    if client_cfg.lr_decay != 1.0:
+        # mirror config.validate(): no lr_scale is plumbed into
+        # local_train here, so decay would be silently dropped for a
+        # direct engine caller (ADVICE r4 #1)
+        raise ValueError("gossip does not support client.lr_decay")
     if not 0.0 < gamma <= 0.5:
         # γ > 1/2 makes the ring weights non-contractive (negative
         # self-weight); γ ≤ 0 is no mixing at all

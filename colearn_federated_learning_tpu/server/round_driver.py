@@ -9,6 +9,7 @@ host work is just index-tensor construction and a scalar metrics fetch.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Any, Dict, Optional
@@ -862,6 +863,55 @@ class Experiment:
             return None
         return CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
 
+    # EF residuals and scaffold/feddyn control variates share the
+    # checkpoint key "c_clients" (same [N_pad, ...] shapes); a resume
+    # across algorithm/EF settings would silently reinterpret one as the
+    # other (ADVICE r4 #3). A sidecar records the store's semantics.
+    def _state_kind(self) -> Dict[str, Any]:
+        return {"algorithm": self.cfg.algorithm,
+                "error_feedback": bool(self.ef)}
+
+    def _state_kind_path(self) -> str:
+        return os.path.join(self._run_dir(), "ckpt", "STATE_KIND.json")
+
+    def _write_state_kind(self) -> None:
+        if not self._primary or not self.cfg.run.out_dir:
+            return
+        path = self._state_kind_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # atomic: a crash mid-write must not leave a truncated sidecar
+        # that would later read as corrupt
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._state_kind(), f)
+        os.replace(tmp, path)
+
+    def _check_state_kind(self) -> None:
+        """Reject a run whose existing checkpoint store was written under
+        different state semantics. Absent sidecar (pre-r5 run dirs) is
+        accepted for backward compatibility; a corrupt sidecar is an
+        error (silently skipping the check would defeat it)."""
+        try:
+            with open(self._state_kind_path()) as f:
+                saved = json.load(f)
+        except FileNotFoundError:
+            return
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"corrupt state-kind sidecar {self._state_kind_path()}: {e}; "
+                f"delete it (accepting the pre-r5 no-provenance behavior) "
+                f"or use a fresh run.out_dir"
+            ) from e
+        want = self._state_kind()
+        if saved != want:
+            raise ValueError(
+                f"checkpoint store at {self._state_kind_path()} was written "
+                f"with state semantics {saved}, but this run is configured "
+                f"as {want}; 'c_clients' rows would be silently "
+                f"reinterpreted — use a fresh run.out_dir or match the "
+                f"original algorithm/error_feedback settings"
+            )
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         caller_state = state is not None
         # Checkpoint provenance baseline: only checkpoints written BY THIS
@@ -931,6 +981,12 @@ class Experiment:
 
     def _fit_body(self, state, store):
         cfg = self.cfg
+        if store and store.latest_step() is not None:
+            # checked for NON-resume runs too: a fresh run over a
+            # mismatched store would overwrite the sidecar while orbax
+            # retains the old run's higher-numbered checkpoints — a later
+            # resume would then load them under the new (wrong) semantics
+            self._check_state_kind()
         if state is None:
             if cfg.run.resume and store and store.latest_step() is not None:
                 template = self.init_state()
@@ -1036,6 +1092,7 @@ class Experiment:
                 if not finite:
                     raise FloatingPointError(f"non-finite params after round {r + 1}")
             if at_ckpt:
+                self._write_state_kind()
                 store.save(r + 1, state)
                 flush_t0 = time.perf_counter()  # keep save time out of the next window
         flush(state)
@@ -1043,6 +1100,7 @@ class Experiment:
         if store:
             store.wait()  # land in-flight async saves before deciding
             if store.latest_step() != int(state["round"]):
+                self._write_state_kind()
                 store.save(int(state["round"]),
                            {k: v for k, v in state.items() if k != "wall_time"},
                            force=True, block=True)

@@ -129,3 +129,53 @@ def test_export_load_roundtrip_and_cli(tmp_path, capsys):
     x = jnp.zeros((2, 28, 28, 1), jnp.float32)
     logits = exp.model.apply({"params": loaded}, x, train=False)
     assert logits.shape == (2, 10)
+
+
+def test_resume_rejects_mismatched_state_semantics(tmp_path):
+    """scaffold and feddyn checkpoints have IDENTICAL state shapes
+    (c_global + per-client c_clients rows) but different semantics;
+    resuming one as the other must be rejected, not silently
+    reinterpreted (ADVICE r4 #3)."""
+    import pytest
+
+    def _alg_cfg(alg, rounds):
+        cfg = _cfg(tmp_path, rounds)
+        cfg.algorithm = alg
+        cfg.client.momentum = 0.0
+        return cfg
+
+    Experiment(_alg_cfg("scaffold", 2), echo=False).fit()
+    cfg_b = _alg_cfg("feddyn", 4)
+    cfg_b.run.resume = True
+    with pytest.raises(ValueError, match="state semantics"):
+        Experiment(cfg_b, echo=False).fit()
+    # matching semantics still resumes fine from the same store
+    cfg_c = _alg_cfg("scaffold", 4)
+    cfg_c.run.resume = True
+    resumed = Experiment(cfg_c, echo=False).fit()
+    assert int(resumed["round"]) == 4
+
+
+def test_fresh_run_rejects_mismatched_store(tmp_path):
+    """A NON-resume run into an out_dir holding mismatched-semantics
+    checkpoints must also be rejected: it would overwrite the sidecar
+    while orbax retains the old run's higher-numbered steps, blessing
+    them for a later resume under the wrong semantics."""
+    import pytest
+
+    def _alg_cfg(alg, rounds):
+        cfg = _cfg(tmp_path, rounds)
+        cfg.algorithm = alg
+        cfg.client.momentum = 0.0
+        return cfg
+
+    Experiment(_alg_cfg("scaffold", 2), echo=False).fit()
+    with pytest.raises(ValueError, match="state semantics"):
+        Experiment(_alg_cfg("feddyn", 2), echo=False).fit()
+    # corrupt sidecar is an error, not a silent skip
+    import os
+    sk = os.path.join(tmp_path, "mnist_fedavg_2", "ckpt", "STATE_KIND.json")
+    with open(sk, "w") as f:
+        f.write("{not json")
+    with pytest.raises(ValueError, match="corrupt state-kind"):
+        Experiment(_alg_cfg("scaffold", 2), echo=False).fit()

@@ -76,7 +76,11 @@ def test_cli_configs_lists_all(capsys):
     assert cli_main(["configs"]) == 0
     out = capsys.readouterr().out.split()
     assert "cifar10_fedavg_100" in out and "cifar10_fedavg_1000" in out
-    assert len(out) == 6
+    # Assert against the registry, not a hard-coded count, so adding a
+    # named config cannot silently stale this test (VERDICT r4 weak-#1).
+    from colearn_federated_learning_tpu.config import list_named_configs
+
+    assert sorted(out) == sorted(list_named_configs())
 
 
 def test_eval_scan_parity(tmp_path):

@@ -191,7 +191,9 @@ def test_ef_memory_is_the_compression_residual():
 def test_ef_dropout_keeps_memory_and_round_exact():
     """A dropped client (n_ex = 0 upstream zeroing) must keep its eᵢ
     bit-identical and contribute nothing: the round must equal the same
-    round run with the client's weight already zero."""
+    round run with the dropped client's training data scrambled — i.e.
+    its data cannot reach the aggregate through any path (ADVICE r4 #4:
+    the equality claim is now actually tested)."""
     model, params, x, y, idx, mask, n_ex = _setup()
     mesh = build_client_mesh(8)
     init, sh, _ = _engines(model, mesh)
@@ -211,6 +213,26 @@ def test_ef_dropout_keeps_memory_and_round_exact():
             np.asarray(new)[3], np.asarray(old)[3]
         ),
         store1, store,
+    )
+    # control: same round, but the dropped client gathers COMPLETELY
+    # different corpus rows — params, server state and store must match
+    # bitwise, proving the zero weight severs every data path
+    idx_ctl = np.asarray(idx).copy()
+    idx_ctl[3] = (idx_ctl[3] + 17) % x.shape[0]
+    p2, _, store2, _ = sh(
+        params, init(params), x, y, jnp.asarray(idx_ctl),
+        jnp.asarray(mask_drop), jnp.asarray(n_drop), jax.random.PRNGKey(1),
+        store, jnp.asarray(cohort),
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        p1, p2,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        store1, store2,
     )
     # and the aggregate is finite / sane (the garbage C(e) never ships)
     jax.tree.map(lambda p: np.testing.assert_array_equal(
