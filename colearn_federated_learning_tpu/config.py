@@ -106,6 +106,11 @@ class ServerConfig:
     compression: str = ""
     compression_topk_ratio: float = 0.01
     compression_qsgd_levels: int = 256
+    # topk thresholds leaves > 65536 coords from a sampled quantile
+    # (selected count within ±10% of k; see ops/compression.py). True
+    # restores the exact full-sort threshold — 10× the training step's
+    # device time on ResNet-18-sized models (BASELINE.md r4/r5).
+    compression_topk_exact: bool = False
     # Error-feedback compression memory (EF-SGD family — Seide et al.
     # 2014, Stich et al. 2018): each client keeps a persistent
     # params-shaped residual eᵢ in the device-resident per-client state
@@ -155,12 +160,24 @@ class ServerConfig:
     # scatter-back (in-round state math always runs f32); keep
     # "float32" unless the store dominates HBM.
     client_state_dtype: str = "float32"  # float32 | bfloat16
-    # Cohort sampling: uniform over clients, or weighted with
-    # p ∝ client shard size (big-data clients drawn more often; pairs
-    # with uniform aggregation weights — the standard importance-sampling
-    # heuristic for example-weighted FedAvg, exact in the
-    # with-replacement limit).
-    sampling: str = "uniform"  # uniform | weighted
+    # Cohort sampling:
+    #   uniform  — fixed-size cohort, without replacement (classic).
+    #   weighted — fixed-size, p ∝ client shard size (big-data clients
+    #              drawn more often; pairs with uniform aggregation
+    #              weights — the standard importance-sampling heuristic
+    #              for example-weighted FedAvg, exact in the
+    #              with-replacement limit).
+    #   poisson  — every client independently participates with
+    #              q = cohort_size/num_clients; the realized cohort is
+    #              VARIABLE, padded to a static cap (≈K + 5σ, lane-
+    #              rounded; overflow raises — observable abort whose
+    #              exact binomial-tail probability is logged as
+    #              dp_delta_abort). This is the sampling under which
+    #              the client-level DP accountant's Poisson
+    #              subsampled-Gaussian bound is EXACT (VERDICT r4
+    #              missing-#3); under uniform/weighted it is an
+    #              approximation (see dp_client_epsilon).
+    sampling: str = "uniform"  # uniform | weighted | poisson
     # Simulated client dropout: fraction of the sampled cohort whose
     # update is zeroed inside the round function (total failure).
     dropout_rate: float = 0.0
@@ -196,6 +213,20 @@ class ServerConfig:
     secure_aggregation: bool = False
     # fixed-point quantization step for secure aggregation
     secagg_quant_step: float = 1e-4
+    # Mask construction (privacy/secagg_keys.py):
+    #   "ring"     — O(K) mask streams from one key; dropout recovery
+    #                uses the shared key (arithmetic-exact simulation,
+    #                the fast default).
+    #   "pairwise" — the Bonawitz et al. 2017 §4-5 protocol shape:
+    #                per-pair DH-agreed seeds, t-of-n Shamir recovery of
+    #                dropped clients' seeds, round ABORTS below the
+    #                threshold. O(K²) mask streams — opt-in; overhead
+    #                measured in BASELINE.md r5.
+    secagg_mode: str = "ring"
+    # Shamir threshold t for pairwise mode: ≥t survivor shares
+    # reconstruct a dropped client's seeds, t−1 reveal nothing.
+    # 0 = auto (⌊K/2⌋+1, the honest-but-curious majority setting).
+    secagg_threshold: int = 0
     # An int32 WRAP in the masked aggregate silently corrupts the round,
     # so a config whose worst-case bound cohort·max_weight·clip/
     # quant_step reaches 2^31 is REJECTED at Experiment construction
@@ -403,7 +434,7 @@ class ExperimentConfig:
             if self.server.sampling != "uniform":
                 raise ValueError(
                     "gossip schedules all clients every round; "
-                    "server.sampling=weighted is not supported"
+                    f"server.sampling={self.server.sampling} is not supported"
                 )
             if (self.server.aggregator != "weighted_mean"
                     or self.server.compression
@@ -458,7 +489,7 @@ class ExperimentConfig:
             if self.server.sampling != "uniform":
                 raise ValueError(
                     "fedbuff schedules clients via its own in-flight queue; "
-                    "server.sampling=weighted is not supported"
+                    f"server.sampling={self.server.sampling} is not supported"
                 )
             if self.data.placement != "hbm":
                 raise ValueError("fedbuff requires data.placement=hbm")
@@ -516,8 +547,22 @@ class ExperimentConfig:
                 )
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
-        if self.server.sampling not in ("uniform", "weighted"):
+        if self.server.sampling not in ("uniform", "weighted", "poisson"):
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
+        if (self.server.sampling == "poisson"
+                and self.server.secure_aggregation
+                and self.server.secagg_mode == "pairwise"):
+            # pairwise secagg's key agreement + Shamir threshold assume a
+            # KNOWN cohort that commits keys; Poisson's pad slots are
+            # nonexistent clients, which would both skew the threshold
+            # semantics (t vs a cap-sized ring) and force per-round
+            # recovery work for every unfilled slot. Ring-mode secagg
+            # composes fine (pad slots behave as committed-then-dropped).
+            raise ValueError(
+                "sampling=poisson is incompatible with "
+                "secagg_mode='pairwise' (unknown-cohort key agreement); "
+                "use secagg_mode='ring'"
+            )
         if self.server.aggregator not in (
             "weighted_mean", "median", "trimmed_mean", "krum"
         ):
@@ -700,6 +745,22 @@ class ExperimentConfig:
                 raise ValueError(
                     f"secagg_quant_step must be > 0, "
                     f"got {self.server.secagg_quant_step}"
+                )
+            if self.server.secagg_mode not in ("ring", "pairwise"):
+                raise ValueError(
+                    f"server.secagg_mode must be 'ring' or 'pairwise', "
+                    f"got {self.server.secagg_mode!r}"
+                )
+            t = self.server.secagg_threshold
+            if t != 0 and self.server.secagg_mode != "pairwise":
+                raise ValueError(
+                    "server.secagg_threshold only applies to "
+                    "secagg_mode='pairwise'"
+                )
+            if t != 0 and not 2 <= t <= self.server.cohort_size:
+                raise ValueError(
+                    f"server.secagg_threshold must be in [2, cohort_size="
+                    f"{self.server.cohort_size}], got {t}"
                 )
         if not 0.0 <= self.server.straggler_rate <= 1.0:
             raise ValueError(

@@ -223,6 +223,36 @@ def _client_dp_noise(dp_key, template, std):
     return jax.tree.unflatten(treedef, out)
 
 
+def _secagg_quantize(delta_b, b_w, b_part, quant_step: float):
+    """Weighted fixed-point quantization of a delta block, shared by
+    both mask modes AND pinned behind optimization barriers: the
+    weighting multiply and the round(c/step) must lower to the SAME
+    instructions whether this runs eagerly (sequential oracle), inside
+    a jitted helper, or fused into the sharded round program — an FMA/
+    reassociation difference of one ulp at a .5 boundary flips a
+    quantization unit and breaks the engines' bitwise-parity contract
+    (observed: ring-eager vs pairwise-jit diverged by exactly 1 unit on
+    2 of 60k coordinates before the barriers)."""
+    part = b_part.astype(jnp.float32)
+    contrib = jax.tree.map(
+        lambda dd: dd * (part * b_w.astype(jnp.float32)).reshape(
+            (dd.shape[0],) + (1,) * (dd.ndim - 1)
+        ),
+        delta_b,
+    )
+    contrib = jax.lax.optimization_barrier(contrib)
+    # multiply by the PRECOMPUTED f32 reciprocal instead of dividing:
+    # XLA canonicalizes division-by-constant to reciprocal multiplication
+    # under jit but NOT in eager dispatch, and the two round differently
+    # at .5 boundaries (observed: c/1e-4 = 2.5000002 vs c*1e4 = 2.5) —
+    # doing the multiply ourselves makes every context emit the same op
+    inv_step = jnp.float32(1.0 / quant_step)
+    q = jax.tree.map(
+        lambda c: jnp.round(c * inv_step).astype(jnp.int32), contrib
+    )
+    return jax.lax.optimization_barrier(q)
+
+
 def _secagg_masks(mask_key, slot, template):
     """Uniform int32 mask tree for one client ``slot`` (SecAgg core,
     Bonawitz et al. 2017 §4 arithmetic): one threefry stream per
@@ -264,16 +294,7 @@ def _secagg_upload(delta_b, b_w, b_slot, b_part, mask_key, params,
 
     Both terms ride the same int32 accumulator, so cancellation stays
     exact mod 2^32. Shared by both engines."""
-    part = b_part.astype(jnp.float32)
-    contrib = jax.tree.map(
-        lambda dd: dd * (part * b_w.astype(jnp.float32)).reshape(
-            (dd.shape[0],) + (1,) * (dd.ndim - 1)
-        ),
-        delta_b,
-    )
-    q = jax.tree.map(
-        lambda c: jnp.round(c / quant_step).astype(jnp.int32), contrib
-    )
+    q = _secagg_quantize(delta_b, b_w, b_part, quant_step)
     b_next = (b_slot + 1) % cohort_size
     m_own = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_slot)
     m_nxt = jax.vmap(lambda s: _secagg_masks(mask_key, s, params))(b_next)
@@ -287,6 +308,89 @@ def _secagg_upload(delta_b, b_w, b_slot, b_part, mask_key, params,
         return upload + reconstruction
 
     return jax.tree.map(merge, q, m_own, m_nxt)
+
+
+# base key for expanding a 32-bit pairwise seed into a params-shaped
+# mask stream; distinct from every other stream family in the program
+_SECAGG_PAIR_FOLD = 0x5ECA67
+
+
+def _pairwise_prg(seed_u32, template):
+    """Expand one pairwise seed into a params-shaped int32 mask tree:
+    one threefry stream per (seed, leaf), bitcast so all 32 bits
+    survive. BOTH endpoints of a pair (and the server's reconstruction)
+    expand the identical stream from the identical seed — that identity
+    is the whole cancellation argument."""
+    leaves, treedef = jax.tree.flatten(template)
+    ks = jax.random.fold_in(
+        jax.random.PRNGKey(_SECAGG_PAIR_FOLD), seed_u32
+    )
+    out = []
+    for i, leaf in enumerate(leaves):
+        bits = jax.random.bits(
+            jax.random.fold_in(ks, i), leaf.shape, jnp.uint32
+        )
+        out.append(jax.lax.bitcast_convert_type(bits, jnp.int32))
+    return jax.tree.unflatten(treedef, out)
+
+
+def _secagg_pairwise_upload(delta_b, b_w, b_slot, b_part, part_full,
+                            seeds, params, quant_step: float,
+                            cohort_size: int):
+    """One block's pairwise-masked contributions (Bonawitz §4–5 shape;
+    ``server.secagg_mode="pairwise"``). Per client i the protocol's two
+    message kinds are:
+
+    - **survivor upload**: q_i + Σ_{j>i} PRG(s_ij) − Σ_{j<i} PRG(s_ij)
+      — every pair's stream appears once with + and once with −, so the
+      full-cohort sum telescopes to zero exactly (mod 2^32).
+    - **server reconstruction** (i dropped): the survivors' uploads
+      contain the now-uncancelled terms sgn(i−s)·PRG(s_si); the server,
+      holding i's Shamir-reconstructed seeds (privacy/secagg_keys.py —
+      the driver performs that recovery for real and aborts below
+      threshold), adds −Σ_{s surviving} sgn(i−s)·PRG(s_si).
+
+    Both reduce to one signed coefficient per ordered pair —
+    ``coeff_ij = sgn(j−i)·[part_i·1(j≠i) + (1−part_i)·part_j]``
+    (for i surviving the mask sign; for i dropped, −sgn(i−j)·part_j =
+    sgn(j−i)·part_j, the reconstruction sign) — so each pair stream is
+    expanded ONCE per client row. Cost: K·(K−1) PRG expansions of
+    |params| per round (the real protocol's client-side cost, all paid
+    on one chip here) vs the ring mode's 2K; opt-in accordingly.
+    """
+    q = _secagg_quantize(delta_b, b_w, b_part, quant_step)
+    parti_full = part_full.astype(jnp.int32)  # [K]
+
+    def one_client(slot, p_i, q_i):
+        row = seeds[slot]  # [K] this client's pairwise seeds
+        j_ids = jnp.arange(cohort_size, dtype=jnp.int32)
+        sgn = jnp.sign(j_ids - slot).astype(jnp.int32)
+        coeff = sgn * (
+            p_i * (j_ids != slot).astype(jnp.int32)
+            + (1 - p_i) * parti_full
+        )  # [K] ∈ {-1, 0, +1}
+
+        def add_pair(acc, sj):
+            s_ij, c_ij = sj
+            m = _pairwise_prg(s_ij, params)
+            return jax.tree.map(lambda a, mm: a + c_ij * mm, acc, m), None
+
+        acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.int32), params)
+        if any(
+            CLIENT_AXIS in getattr(jax.typeof(x), "vma", frozenset())
+            for x in jax.tree.leaves(q_i)
+        ):
+            # under shard_map the carry becomes device-varying after the
+            # first += (coeff depends on this lane's slot); the initial
+            # zeros must match (scan-vma typing). No-op for the eager
+            # sequential oracle, which has no mesh context.
+            acc0 = _pcast_varying(acc0)
+        masked, _ = jax.lax.scan(add_pair, acc0, (row, coeff))
+        # survivors ship q + mask; dropped ship only the reconstruction
+        return jax.tree.map(lambda qq, mm: p_i * qq + mm, q_i, masked)
+
+    parti = b_part.astype(jnp.int32)
+    return jax.vmap(one_client)(b_slot, parti, q)
 
 
 def _feddyn_prepare(client_cfg, scaffold, feddyn_alpha, aggregator,
@@ -344,14 +448,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           aggregator: str = "weighted_mean",
                           trim_ratio: float = 0.1,
                           compression: str = "", topk_ratio: float = 0.01,
-                          qsgd_levels: int = 256,
+                          qsgd_levels: int = 256, topk_exact: bool = False,
                           clip_delta_norm: float = 0.0,
                           feddyn_alpha: float = 0.0,
                           byzantine_f: int = 0,
                           scan_unroll: int = 1,
                           secagg: bool = False,
                           secagg_quant_step: float = 1e-4,
+                          secagg_mode: str = "ring",
                           client_dp_noise: float = 0.0,
+                          dp_fixed_denom: float = 0.0,
                           downlink: str = "",
                           downlink_levels: int = 256,
                           error_feedback: bool = False):
@@ -506,7 +612,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         make_compressor,
     )
 
-    compress = make_compressor(compression, topk_ratio, qsgd_levels)
+    compress = make_compressor(compression, topk_ratio, qsgd_levels,
+                               topk_exact=topk_exact)
 
     def _bcast(params, rng):
         """The weights clients actually receive this round."""
@@ -560,7 +667,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 cohort_rep,
             )
         if secagg:
-            mask_key = rest.pop(0)
+            if secagg_mode == "pairwise":
+                # [K, K] replicated pairwise-seed matrix (host-built by
+                # privacy/secagg_keys.py: DH agreement; dropped rows are
+                # the server's Shamir reconstruction). Masks still
+                # commit to the static full cohort before training.
+                pair_seeds = rest.pop(0)
+                part_full = jax.lax.all_gather(
+                    n_ex > 0, CLIENT_AXIS, tiled=True
+                )
+            else:
+                mask_key = rest.pop(0)
             # the mask ring is STATIC over the full cohort (committed
             # before training / before dropouts are known): this lane's
             # global slots are its position in the cohort layout
@@ -655,10 +772,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # survivor uploads + server mask reconstruction for
                 # dropped clients (n = 0); the int32 accumulator's
                 # wraparound is the protocol's mod-2^32 arithmetic
-                upload_b = _secagg_upload(
-                    delta_b, b_w, b_slot, b_n > 0, mask_key, params,
-                    secagg_quant_step, cohort_size,
-                )
+                if secagg_mode == "pairwise":
+                    upload_b = _secagg_pairwise_upload(
+                        delta_b, b_w, b_slot, b_n > 0, part_full,
+                        pair_seeds, params, secagg_quant_step, cohort_size,
+                    )
+                else:
+                    upload_b = _secagg_upload(
+                        delta_b, b_w, b_slot, b_n > 0, mask_key, params,
+                        secagg_quant_step, cohort_size,
+                    )
                 d_acc = jax.tree.map(
                     lambda a, u: a + u.sum(0), d_acc, upload_b
                 )
@@ -745,8 +868,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # data-dependent denominator is itself private and would break
         # the sensitivity analysis (dropout then attenuates the
         # estimator instead of leaking through the divisor).
+        # under poisson sampling the engine's static row count is the
+        # PADDED cap; the DP estimator's fixed public denominator stays
+        # the nominal qN = configured cohort_size (dp_fixed_denom)
         agg_denom = (
-            jnp.float32(cohort_size) if client_dp_noise > 0.0 else denom
+            jnp.float32(dp_fixed_denom or cohort_size)
+            if client_dp_noise > 0.0 else denom
         )
         if robust:
             out["deltas"] = unblock(ys["delta"])  # client-sharded stack
@@ -926,11 +1053,20 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
-                     n_ex, rng):
+                     n_ex, rng, pair_seeds=None):
             keys = jax.random.split(rng, idx.shape[0])
-            # the mask key is a pure function of the round rng — every
-            # lane (and the sequential oracle) derives the same streams
-            mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
+            if secagg_mode == "pairwise":
+                # pairwise mode: the seed matrix is a host-built INPUT
+                # (key agreement + Shamir recovery are host protocol
+                # steps), not derivable from the round rng
+                if pair_seeds is None:
+                    raise TypeError("secagg_mode='pairwise' requires pair_seeds")
+                secagg_in = pair_seeds
+            else:
+                # ring mode: the mask key is a pure function of the
+                # round rng — every lane (and the sequential oracle)
+                # derives the same streams
+                secagg_in = jax.random.fold_in(rng, _SECAGG_FOLD)
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
@@ -940,7 +1076,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             )
             out = sharded_lane(
                 _bcast(params, rng), train_x, train_y, idx, mask, n_ex,
-                keys, *extra, mask_key, *tail,
+                keys, *extra, secagg_in, *tail,
             )
             new_params, new_opt_state = server_update(
                 params, server_opt_state, out["mean_delta"]
@@ -1129,14 +1265,16 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              aggregator: str = "weighted_mean",
                              trim_ratio: float = 0.1,
                              compression: str = "", topk_ratio: float = 0.01,
-                             qsgd_levels: int = 256,
+                             qsgd_levels: int = 256, topk_exact: bool = False,
                              clip_delta_norm: float = 0.0,
                              feddyn_alpha: float = 0.0,
                              byzantine_f: int = 0,
                              secagg: bool = False,
                              secagg_quant_step: float = 1e-4,
+                             secagg_mode: str = "ring",
                              scan_unroll: int = 1,
                              client_dp_noise: float = 0.0,
+                             dp_fixed_denom: float = 0.0,
                              downlink: str = "",
                              downlink_levels: int = 256,
                              error_feedback: bool = False):
@@ -1175,7 +1313,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         make_compressor,
     )
 
-    compress = make_compressor(compression, topk_ratio, qsgd_levels)
+    compress = make_compressor(compression, topk_ratio, qsgd_levels,
+                               topk_exact=topk_exact)
     local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
                                               local_dtype=local_dtype,
                                               scan_unroll=scan_unroll))
@@ -1184,7 +1323,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     use_decay = client_cfg.lr_decay != 1.0
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
-                 c_global=None, c_cohort=None):
+                 c_global=None, c_cohort=None, pair_seeds=None):
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
         lr_scale = (
@@ -1202,11 +1341,22 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 downlink_levels,
             )
         if secagg:
-            # identical mask-key derivation + per-client streams as the
+            # identical mask derivation + per-client streams as the
             # sharded engine; int32 sums are order-independent mod 2^32,
-            # so the two engines agree BITWISE on the aggregate. The
-            # ring is the static full cohort (slot c → c+1 mod K).
-            mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
+            # so the two engines agree BITWISE on the aggregate. Ring
+            # mode: static full-cohort ring (slot c → c+1 mod K);
+            # pairwise mode: host-built seed matrix input.
+            if secagg_mode == "pairwise":
+                if pair_seeds is None:
+                    raise TypeError("secagg_mode='pairwise' requires pair_seeds")
+                part_full = jnp.asarray(n_ex) > 0
+                # eager per-client calls re-trace the K-step PRG scan
+                # every time (~seconds each); jit it once per shape
+                pairwise_up = jax.jit(
+                    _secagg_pairwise_upload, static_argnums=(7, 8)
+                )
+            else:
+                mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
             q_acc = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.int32), params
             )
@@ -1290,12 +1440,20 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 # only the masked int32 accumulator survives the loop —
                 # keeping the raw f32 deltas too would retain cohort×
                 # params dead memory
-                up = _secagg_upload(
-                    jax.tree.map(lambda a: a[None], delta_i),
-                    jnp.asarray(weights[-1])[None],
-                    slots[c][None], (jnp.asarray(n_ex[c]) > 0)[None],
-                    mask_key, params, secagg_quant_step, k,
-                )
+                if secagg_mode == "pairwise":
+                    up = pairwise_up(
+                        jax.tree.map(lambda a: a[None], delta_i),
+                        jnp.asarray(weights[-1])[None],
+                        slots[c][None], (jnp.asarray(n_ex[c]) > 0)[None],
+                        part_full, pair_seeds, params, secagg_quant_step, k,
+                    )
+                else:
+                    up = _secagg_upload(
+                        jax.tree.map(lambda a: a[None], delta_i),
+                        jnp.asarray(weights[-1])[None],
+                        slots[c][None], (jnp.asarray(n_ex[c]) > 0)[None],
+                        mask_key, params, secagg_quant_step, k,
+                    )
                 q_acc = jax.tree.map(lambda a, u: a + u[0], q_acc, up)
             else:
                 deltas.append(delta_i)
@@ -1303,7 +1461,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         w_sum = jnp.sum(jnp.stack(weights))
         denom = jnp.where(w_sum > 0, w_sum, 1.0)
         # fixed public denominator under client DP (see the sharded lane)
-        agg_denom = jnp.float32(k) if client_dp_noise > 0.0 else denom
+        agg_denom = (
+            jnp.float32(dp_fixed_denom or k)
+            if client_dp_noise > 0.0 else denom
+        )
         if robust:
             from colearn_federated_learning_tpu.server.aggregation import (
                 robust_reduce,

@@ -74,7 +74,23 @@ class Experiment:
             weights=(
                 self.fed.client_sizes() if cfg.server.sampling == "weighted" else None
             ),
+            mode="poisson" if cfg.server.sampling == "poisson" else "fixed",
         )
+        # Poisson sampling: the realized Binomial(N, q) cohort is padded
+        # to a STATIC cap of K + 5σ (so XLA never retraces); overflow
+        # raises — an OBSERVABLE abort whose exact binomial-tail
+        # probability is logged as dp_delta_abort and belongs to the DP
+        # δ (the (ε, δ + δ_abort) composition for aborting mechanisms).
+        self._poisson = cfg.server.sampling == "poisson"
+        self._poisson_cap = 0
+        if self._poisson:
+            import math as _math
+
+            _k, _n = cfg.server.cohort_size, self.fed.num_clients
+            _q = _k / _n
+            self._poisson_cap = min(
+                _n, _k + _math.ceil(5.0 * _math.sqrt(_k * (1.0 - _q))) + 1
+            )
         self.server_opt_init, server_update = make_server_update_fn(cfg.server)
         # SCAFFOLD (cfg.algorithm): per-client control variates live as
         # one stacked [N_pad, ...] tree per leaf. Under the sharded
@@ -137,8 +153,9 @@ class Experiment:
             # invalidate the sensitivity analysis (ServerConfig docs)
             agg = "uniform"
         self._agg_mode = agg
-        if self.secagg:
-            self._check_secagg_bounds()
+        # (the secagg fixed-point bound check runs AFTER engine
+        # construction so the poisson cap is already lane-rounded —
+        # the bound must cover the padded worst case)
 
         if cfg.run.engine == "sharded":
             batch_shards = max(1, cfg.run.batch_shards)
@@ -155,13 +172,16 @@ class Experiment:
                 )
             if cfg.run.num_lanes:
                 lanes = cfg.run.num_lanes
-                if cfg.server.cohort_size % lanes != 0:
+                if not self._poisson and cfg.server.cohort_size % lanes != 0:
                     raise ValueError(
                         f"run.num_lanes={lanes} must divide cohort_size="
                         f"{cfg.server.cohort_size} (set num_lanes=0 to auto-pick)"
                     )
             else:
                 lanes = mesh_lib.largest_lane_count(cfg.server.cohort_size, avail)
+            if self._poisson:
+                # static rows must divide the lanes; pad rows are free
+                self._poisson_cap = -(-self._poisson_cap // lanes) * lanes
             self.mesh = mesh_lib.build_client_mesh(lanes, batch_shards=batch_shards)
             if self.gossip:
                 from colearn_federated_learning_tpu.parallel.gossip import (
@@ -190,7 +210,9 @@ class Experiment:
             else:
                 self.round_fn = make_sharded_round_fn(
                     self.model, cfg.client, cfg.dp, self.task, self.mesh,
-                    server_update, cfg.server.cohort_size,
+                    server_update,
+                    self._poisson_cap or cfg.server.cohort_size,
+                    dp_fixed_denom=cfg.server.cohort_size,
                     client_vmap_width=cfg.run.client_vmap_width,
                     local_dtype=self._local_dtype(), agg=agg,
                     scaffold=self.scaffold, num_clients=self.fed.num_clients,
@@ -199,6 +221,7 @@ class Experiment:
                     compression=cfg.server.compression,
                     topk_ratio=cfg.server.compression_topk_ratio,
                     qsgd_levels=cfg.server.compression_qsgd_levels,
+                    topk_exact=cfg.server.compression_topk_exact,
                     clip_delta_norm=cfg.server.clip_delta_norm,
                     feddyn_alpha=(
                         cfg.server.feddyn_alpha if self.feddyn else 0.0
@@ -207,6 +230,7 @@ class Experiment:
                     scan_unroll=cfg.run.scan_unroll,
                     secagg=self.secagg,
                     secagg_quant_step=cfg.server.secagg_quant_step,
+                    secagg_mode=cfg.server.secagg_mode,
                     client_dp_noise=cfg.server.dp_client_noise_multiplier,
                     downlink=cfg.server.downlink_compression,
                     downlink_levels=cfg.server.downlink_qsgd_levels,
@@ -223,6 +247,7 @@ class Experiment:
             self.mesh = None
             self.round_fn = make_sequential_round_fn(
                 self.model, cfg.client, cfg.dp, self.task, server_update,
+                dp_fixed_denom=cfg.server.cohort_size,
                 local_dtype=self._local_dtype(), agg=agg,
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
                 aggregator=cfg.server.aggregator,
@@ -230,6 +255,7 @@ class Experiment:
                 compression=cfg.server.compression,
                 topk_ratio=cfg.server.compression_topk_ratio,
                 qsgd_levels=cfg.server.compression_qsgd_levels,
+                topk_exact=cfg.server.compression_topk_exact,
                 clip_delta_norm=cfg.server.clip_delta_norm,
                 feddyn_alpha=(
                     cfg.server.feddyn_alpha if self.feddyn else 0.0
@@ -237,6 +263,7 @@ class Experiment:
                 byzantine_f=cfg.server.krum_byzantine,
                 secagg=self.secagg,
                 secagg_quant_step=cfg.server.secagg_quant_step,
+                secagg_mode=cfg.server.secagg_mode,
                 scan_unroll=cfg.run.scan_unroll,
                 client_dp_noise=cfg.server.dp_client_noise_multiplier,
                 downlink=cfg.server.downlink_compression,
@@ -248,6 +275,11 @@ class Experiment:
             self._client_sharding = None
             self.n_chips = 1
             self._state_rows = self.fed.num_clients
+
+        if self.secagg:
+            # after engine construction: the poisson cap (if any) is now
+            # lane-rounded, so the worst-case aggregate bound is final
+            self._check_secagg_bounds()
 
         # Training-corpus placement (SURVEY.md §2 C10 at scale):
         #   hbm    — dataset bytes go to HBM exactly once (replicated over
@@ -345,7 +377,13 @@ class Experiment:
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
         # the round loop's critical path; NumPy path otherwise.
         self._native = None
-        if cfg.run.host_pipeline in ("auto", "native"):
+        if self._poisson and cfg.run.host_pipeline == "native":
+            raise ValueError(
+                "run.host_pipeline=native does not support "
+                "server.sampling=poisson (variable cohorts are padded "
+                "host-side); use host_pipeline=numpy"
+            )
+        if cfg.run.host_pipeline in ("auto", "native") and not self._poisson:
             from colearn_federated_learning_tpu import native
 
             if native.available():
@@ -394,7 +432,9 @@ class Experiment:
                 "secagg_quant_step",
                 per_client,
             )
-        bound = s.cohort_size * per_client
+        # poisson: worst case is the static cap (more than K clients can
+        # realize); fixed: the cohort size
+        bound = (self._poisson_cap or s.cohort_size) * per_client
         if bound >= 2**31:
             if s.secagg_allow_wrap_risk:
                 log.warning(
@@ -603,12 +643,40 @@ class Experiment:
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
         mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng)
+        if self._poisson:
+            cap, b = self._poisson_cap, len(cohort)
+            if b > cap:
+                raise RuntimeError(
+                    f"poisson cohort {b} exceeded the static cap {cap} "
+                    f"(a ~5-sigma event; its exact probability is logged "
+                    f"as dp_delta_abort and is part of the DP delta). "
+                    f"Aborting rather than silently truncating — rerun "
+                    f"with a different seed or a larger cohort_size."
+                )
+            pad = cap - b
+            if pad:
+                # pad id == num_clients: OUT OF RANGE by construction, so
+                # state-store scatters drop it and no real client's row
+                # can be touched by a pad slot; pad rows carry zero mask
+                # and zero weight (exact no-ops, the dropout machinery)
+                cohort = np.concatenate(
+                    [cohort, np.full(pad, self.fed.num_clients, cohort.dtype)]
+                )
+                idx = np.concatenate(
+                    [idx, np.zeros((pad,) + idx.shape[1:], idx.dtype)]
+                )
+                mask = np.concatenate(
+                    [mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)]
+                )
+                n_ex = np.concatenate([n_ex, np.zeros(pad, n_ex.dtype)])
         slab = self._stream_slab(idx) if self._stream else None
         return cohort, idx, mask, n_ex, slab
 
     def _apply_failures(self, mask, n_ex, k, host_rng):
         """Straggler truncation + dropout zeroing — shared by the sync
         cohort path and the async (fedbuff) scheduler."""
+        if k == 0:
+            return mask, n_ex  # empty poisson round: nothing to fail
         if self.cfg.server.straggler_rate > 0:
             # simulated stragglers (SURVEY.md §5, FedProx's motivating
             # scenario): a fraction of the cohort completes only
@@ -665,11 +733,12 @@ class Experiment:
             train_y = self._put_data(jnp.asarray(slab_y))
         else:
             train_x, train_y = self.train_x, self.train_y
+        n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._cohort_sharding is not None:
             idx = self._put(idx, self._cohort_sharding)
             mask = self._put(mask, self._cohort_sharding)
             n_ex = self._put(n_ex, self._client_sharding)
-        return cohort, idx, mask, n_ex, train_x, train_y
+        return cohort, idx, mask, n_ex, train_x, train_y, n_host
 
     def _stream_slab(self, idx: np.ndarray):
         """Gather this round's unique example rows into a fixed-shape slab
@@ -768,10 +837,45 @@ class Experiment:
             "_metrics": metrics,
         }
 
+    def _pairwise_seeds(self, round_idx: int, n_host: np.ndarray):
+        """One round of the Bonawitz key protocol, host-side
+        (privacy/secagg_keys.py): fresh per-round DH secrets + Shamir
+        shares, pairwise seed matrix for the cohort, and — when clients
+        dropped (weight 0 at collection) — the server's REAL recovery
+        path: reconstruct each dropped secret from exactly t survivor
+        shares and recompute its seed row from the publics alone.
+        Raises ThresholdError below t survivors (the protocol's defined
+        abort; nothing can be aggregated that round)."""
+        from colearn_federated_learning_tpu.privacy import secagg_keys as sk
+
+        k = self.cfg.server.cohort_size
+        t = self.cfg.server.secagg_threshold or (k // 2 + 1)
+        rng = np.random.default_rng((self.cfg.run.seed, round_idx, 0x5ECA))
+        keys = sk.setup_cohort(rng, k, t)
+        seeds = sk.build_seed_matrix(keys)
+        dropped = np.flatnonzero(n_host == 0)
+        if dropped.size:
+            survivors = np.flatnonzero(n_host > 0)
+            rows = sk.recover_dropped_rows(keys, dropped.tolist(),
+                                           survivors.tolist())
+            for d, row in rows.items():
+                # DH symmetry guarantees the recovered row equals the
+                # client's own; assert it (cheap, and it IS the protocol
+                # correctness property)
+                assert np.array_equal(row, seeds[d]), (
+                    "Shamir-recovered seeds diverge from DH agreement"
+                )
+                seeds[d] = row
+        arr = jnp.asarray(seeds)
+        if self._data_sharding is not None:
+            arr = self._put(arr, self._data_sharding)
+        return arr
+
     def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
-        cohort, idx, mask, n_ex, train_x, train_y = self._round_inputs(round_idx)
+        (cohort, idx, mask, n_ex, train_x, train_y,
+         n_host) = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         if self.gossip:
             replicas, mean_params, metrics = self.round_fn(
@@ -833,9 +937,12 @@ class Experiment:
             if self.stateful:
                 new_state["c_global"] = head[2]
             return new_state
+        kw = {}
+        if self.secagg and self.cfg.server.secagg_mode == "pairwise":
+            kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
         params, opt_state, metrics = self.round_fn(
             state["params"], state["server_opt_state"],
-            train_x, train_y, idx, mask, n_ex, rng,
+            train_x, train_y, idx, mask, n_ex, rng, **kw,
         )
         return {
             "params": params,
@@ -865,11 +972,22 @@ class Experiment:
 
     # EF residuals and scaffold/feddyn control variates share the
     # checkpoint key "c_clients" (same [N_pad, ...] shapes); a resume
-    # across algorithm/EF settings would silently reinterpret one as the
-    # other (ADVICE r4 #3). A sidecar records the store's semantics.
+    # across those settings would silently reinterpret one as the
+    # other (ADVICE r4 #3). A sidecar records the store's SEMANTICS —
+    # not the raw algorithm string: stateless pairs (fedavg ↔ fedprox)
+    # have no c_clients rows and may resume each other freely, while
+    # structurally-different states (gossip replicas, fedbuff queue)
+    # already fail orbax's template restore on their own.
     def _state_kind(self) -> Dict[str, Any]:
-        return {"algorithm": self.cfg.algorithm,
-                "error_feedback": bool(self.ef)}
+        if self.scaffold:
+            kind = "scaffold"
+        elif self.feddyn:
+            kind = "feddyn"
+        elif self.ef:
+            kind = "ef"
+        else:
+            kind = "none"
+        return {"client_state": kind}
 
     def _state_kind_path(self) -> str:
         return os.path.join(self._run_dir(), "ckpt", "STATE_KIND.json")
@@ -1002,6 +1120,16 @@ class Experiment:
                 state = self.init_state()
         state = self._place_state(state)
         start_round = int(state["round"])
+        if start_round == 0 and self._poisson:
+            self.logger.log({
+                "event": "poisson_sampling",
+                "q": round(self.sampler.q, 6),
+                "cap": int(self._poisson_cap),
+                # exact total abort probability over the run — the
+                # δ_abort term of the (ε, δ + δ_abort) guarantee for the
+                # aborting mechanism (see dp_client_epsilon)
+                "dp_delta_abort": float(self.dp_delta_abort()),
+            })
         if start_round == 0 and self.fed.meta.get("repair_used"):
             # the Dirichlet extreme-α repair changed the realized label
             # skew — record it in the run log so experiments at extreme α
@@ -1133,12 +1261,22 @@ class Experiment:
         sampling rate q = cohort/num_clients; δ from cfg.dp.delta.
         config.validate() REJECTS weighted sampling under client DP
         (size-proportional sampling would push a big client's per-round
-        inclusion probability above q). Accounting caveat (stated, not
-        hidden): cohorts are FIXED-SIZE samples without replacement,
-        while the accountant's amplification bound is derived for
-        POISSON subsampling at rate q — the standard approximation in
-        the DP-FedAvg literature (McMahan et al. 2018 §3.1 make the
-        same substitution), not a strict upper bound for WOR sampling.
+        inclusion probability above q).
+
+        Exactness depends on ``server.sampling``:
+
+        - ``"poisson"`` — every client independently participates with
+          probability q each round, which is PRECISELY the mechanism
+          the Poisson subsampled-Gaussian RDP bound is derived for: the
+          reported ε is a sound upper bound at δ + δ_abort, where
+          δ_abort (:meth:`dp_delta_abort`, logged at fit start) is the
+          exact probability that some round's realized cohort overflows
+          the static cap and the run ABORTS (observable, never silent).
+        - ``"uniform"`` — cohorts are fixed-size samples without
+          replacement, while the bound is derived for Poisson
+          subsampling at rate q — the standard approximation in the
+          DP-FedAvg literature (McMahan et al. 2018 §3.1 make the same
+          substitution), not a strict upper bound for WOR sampling.
         """
         from colearn_federated_learning_tpu.privacy.dp import rdp_epsilon
 
@@ -1147,6 +1285,31 @@ class Experiment:
             self.cfg.server.dp_client_noise_multiplier, q, rounds_done,
             self.cfg.dp.delta,
         )
+
+    def dp_delta_abort(self, rounds: Optional[int] = None) -> float:
+        """Exact probability that ANY of the run's poisson rounds
+        realizes a cohort above the static cap (union bound over rounds
+        on the exact Binomial(N, q) upper tail, computed in log space).
+        This is the δ_abort of the aborting mechanism's
+        (ε, δ + δ_abort)-DP guarantee; with the 5σ default cap it is
+        ~1e-8 per run. 0.0 when not poisson or cap == N."""
+        if not self._poisson:
+            return 0.0
+        n, cap = self.fed.num_clients, self._poisson_cap
+        if cap >= n:
+            return 0.0
+        q = self.sampler.q
+        from math import exp, lgamma, log
+
+        lq, l1q = log(q), log(1.0 - q)
+        tail = 0.0
+        for b in range(cap + 1, n + 1):
+            tail += exp(
+                lgamma(n + 1) - lgamma(b + 1) - lgamma(n - b + 1)
+                + b * lq + (n - b) * l1q
+            )
+        t = self.cfg.server.num_rounds if rounds is None else rounds
+        return min(1.0, t * tail)
 
     def evaluate(self, params) -> Dict[str, float]:
         xb, yb, mb = self._eval_data

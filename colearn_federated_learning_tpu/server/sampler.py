@@ -13,20 +13,38 @@ import numpy as np
 
 class CohortSampler:
     def __init__(self, num_clients: int, cohort_size: int, seed: int,
-                 weights: np.ndarray | None = None):
+                 weights: np.ndarray | None = None,
+                 mode: str = "fixed"):
         if cohort_size > num_clients:
             raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
+        if mode not in ("fixed", "poisson"):
+            raise ValueError(f"unknown sampler mode {mode!r}")
         self.num_clients = num_clients
         self.cohort_size = cohort_size
         self.seed = seed
+        self.mode = mode
         if weights is not None:
+            if mode == "poisson":
+                raise ValueError("poisson sampling is unweighted (q = K/N)")
             w = np.asarray(weights, np.float64)
             self.probs = w / w.sum()
         else:
             self.probs = None
 
+    @property
+    def q(self) -> float:
+        """Per-client per-round participation probability (poisson)."""
+        return self.cohort_size / self.num_clients
+
     def sample(self, round_idx: int) -> np.ndarray:
         rng = np.random.default_rng((self.seed, round_idx))
+        if self.mode == "poisson":
+            # independent Bernoulli(q) per client — the sampling scheme
+            # under which the Poisson subsampled-Gaussian RDP bound is
+            # EXACT. Realized size is Binomial(N, q); the driver pads to
+            # its static cap. A zero-participant round is legitimate
+            # (the engine's degenerate-denominator path handles it).
+            return np.flatnonzero(rng.random(self.num_clients) < self.q)
         return np.sort(
             rng.choice(self.num_clients, size=self.cohort_size,
                        replace=False, p=self.probs)

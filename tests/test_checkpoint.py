@@ -156,6 +156,19 @@ def test_resume_rejects_mismatched_state_semantics(tmp_path):
     assert int(resumed["round"]) == 4
 
 
+def test_resume_allows_stateless_algorithm_change(tmp_path):
+    """fedavg -> fedprox is a legitimate warm start (no per-client
+    state exists to reinterpret); the provenance gate keys on STATE
+    SEMANTICS, not the algorithm string."""
+    cfg_a = _cfg(tmp_path, 2)
+    Experiment(cfg_a, echo=False).fit()
+    cfg_b = _cfg(tmp_path, 4)
+    cfg_b.client.prox_mu = 0.1  # fedprox = fedavg + proximal loss term
+    cfg_b.run.resume = True
+    resumed = Experiment(cfg_b, echo=False).fit()
+    assert int(resumed["round"]) == 4
+
+
 def test_fresh_run_rejects_mismatched_store(tmp_path):
     """A NON-resume run into an out_dir holding mismatched-semantics
     checkpoints must also be rejected: it would overwrite the sidecar

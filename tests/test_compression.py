@@ -263,3 +263,70 @@ class TestDownlink:
         cfg.server.downlink_compression = "qsgd"
         with _pytest.raises(ValueError):
             cfg.validate()
+
+
+class TestTopkSampledThreshold:
+    """The sampled-quantile threshold for big leaves (> _TOPK_SAMPLE
+    coords): selected count within ±10% of k, invariant to client
+    blocking, and identical to exact when forced."""
+
+    def test_selected_count_within_band(self):
+        from colearn_federated_learning_tpu.ops.compression import _TOPK_SAMPLE
+
+        n = 1 << 20  # 1M coords: well past the sampling cutoff
+        assert n > _TOPK_SAMPLE
+        keys = jax.random.split(jax.random.PRNGKey(0), 2)
+        d = jax.random.normal(jax.random.PRNGKey(7), (2, n), jnp.float32)
+        for ratio in (0.1, 0.01):
+            comp = make_compressor("topk", topk_ratio=ratio)
+            out = comp({"w": d}, keys)["w"]
+            k = round(ratio * n)
+            nnz = np.count_nonzero(np.asarray(out), axis=1)
+            for c in range(2):
+                assert abs(nnz[c] - k) <= 0.10 * k, (ratio, c, nnz[c], k)
+            # kept coordinates are a superset-by-magnitude selection:
+            # every kept |value| >= every dropped |value|'s threshold
+            mag = np.abs(np.asarray(d))
+            outm = np.abs(np.asarray(out))
+            for c in range(2):
+                kept_min = outm[c][outm[c] > 0].min()
+                dropped_max = mag[c][np.asarray(out)[c] == 0].max()
+                assert kept_min >= dropped_max
+
+    def test_blocking_invariance(self):
+        """Per-client keys make the threshold independent of how clients
+        are blocked into vmap widths (the same invariance qsgd pins)."""
+        n = (1 << 17) + 13
+        keys = jax.random.split(jax.random.PRNGKey(3), 4)
+        d = jax.random.normal(jax.random.PRNGKey(11), (4, n), jnp.float32)
+        comp = make_compressor("topk", topk_ratio=0.05)
+        whole = comp({"w": d}, keys)["w"]
+        parts = jnp.concatenate([
+            comp({"w": d[:2]}, keys[:2])["w"],
+            comp({"w": d[2:]}, keys[2:])["w"],
+        ])
+        np.testing.assert_array_equal(np.asarray(whole), np.asarray(parts))
+
+    def test_exact_flag_restores_full_sort(self):
+        n = 1 << 18
+        keys = jax.random.split(jax.random.PRNGKey(5), 2)
+        d = jax.random.normal(jax.random.PRNGKey(13), (2, n), jnp.float32)
+        comp = make_compressor("topk", topk_ratio=0.01, topk_exact=True)
+        out = np.asarray(comp({"w": d}, keys)["w"])
+        k = round(0.01 * n)
+        np.testing.assert_array_equal(np.count_nonzero(out, axis=1), [k, k])
+        # exact = the k largest magnitudes, verified against numpy
+        mag = np.abs(np.asarray(d))
+        for c in range(2):
+            want = np.zeros(n, np.float32)
+            top = np.argsort(-mag[c])[:k]
+            want[top] = np.asarray(d)[c][top]
+            np.testing.assert_array_equal(out[c], want)
+
+    def test_ratio_one_keeps_everything_on_big_leaf(self):
+        n = (1 << 17) + 1
+        keys = jax.random.split(jax.random.PRNGKey(2), 1)
+        d = jax.random.normal(jax.random.PRNGKey(4), (1, n), jnp.float32)
+        comp = make_compressor("topk", topk_ratio=1.0)
+        np.testing.assert_array_equal(
+            np.asarray(comp({"w": d}, keys)["w"]), np.asarray(d))
