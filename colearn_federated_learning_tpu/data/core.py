@@ -89,6 +89,37 @@ def _synthetic_images(rng: np.random.Generator, n: int, templates: np.ndarray,
     return np.clip(np.rint(x * 255.0), 0, 255).astype(np.uint8), y
 
 
+def _synthetic_template_pair(rng: np.random.Generator, n: int,
+                             templates: np.ndarray, w: float,
+                             label_noise: float = 0.0):
+    """Second synthetic task family (VERDICT r4 weak-#4): each image
+    superposes TWO class templates, x = w·(T_a + T_b)/2 + (1−w)·noise
+    with a ≠ b, and the label is y = (a + b) mod C. Any LINEAR pixel
+    score decomposes additively over the two strokes (s·x ≈ (s·T_a +
+    s·T_b)/2), but the modular-sum label is not additively separable —
+    a linear model is capped far below the ceiling (measured: linear
+    probe ~0.2) while a convnet that detects the strokes and learns the
+    nonlinear readout is not. Unlike a random-pixel teacher (measured:
+    unlearnable by a small convnet — no spatial structure), the strokes
+    keep the task inside what the model family can actually fit, so the
+    regression band stays tight. Label noise sets a strict ceiling.
+
+    Same template sharing as the first family: train and test differ
+    only in draws, never in templates."""
+    num_classes = templates.shape[0]
+    a = rng.integers(0, num_classes, n)
+    b = (a + rng.integers(1, num_classes, n)) % num_classes
+    noise = rng.uniform(0.0, 1.0,
+                        size=(n,) + templates.shape[1:]).astype(np.float32)
+    x = w * (templates[a] + templates[b]) / 2.0 + (1.0 - w) * noise
+    x_u8 = np.clip(np.rint(x * 255.0), 0, 255).astype(np.uint8)
+    y = ((a + b) % num_classes).astype(np.int32)
+    if label_noise > 0.0:
+        flip = rng.random(n) < label_noise
+        y[flip] = rng.integers(0, num_classes, flip.sum()).astype(np.int32)
+    return x_u8, y
+
+
 def _synthetic_text(rng: np.random.Generator, n: int, seq_len: int, vocab: int):
     """Sequences from a fixed sparse Markov chain → next-token prediction is
     learnable well above chance (each symbol has ~4 plausible successors)."""
@@ -142,15 +173,29 @@ def _image_loader(name: str, shape, num_classes: int, real_fn, size_kwarg=None):
             shp = tuple(tx.shape[1:])
         elif cfg.synthetic_fallback:
             rng = np.random.default_rng(_stable_seed(name))
-            templates = rng.uniform(
-                0.0, 1.0, size=(num_classes,) + shp
-            ).astype(np.float32)
             n_train = _scaled_train_size(cfg)
-            w = cfg.synthetic_template_weight
-            tx, ty = _synthetic_images(rng, n_train, templates, w)
-            ex, ey = _synthetic_images(
-                rng, cfg.synthetic_test_size, templates, w
-            )
+            if cfg.synthetic_task == "template_pair":
+                templates = rng.uniform(
+                    0.0, 1.0, size=(num_classes,) + shp
+                ).astype(np.float32)
+                w = cfg.synthetic_template_weight
+                tx, ty = _synthetic_template_pair(
+                    rng, n_train, templates, w,
+                    label_noise=cfg.synthetic_label_noise,
+                )
+                ex, ey = _synthetic_template_pair(
+                    rng, cfg.synthetic_test_size, templates, w,
+                    label_noise=cfg.synthetic_label_noise,
+                )
+            else:
+                templates = rng.uniform(
+                    0.0, 1.0, size=(num_classes,) + shp
+                ).astype(np.float32)
+                w = cfg.synthetic_template_weight
+                tx, ty = _synthetic_images(rng, n_train, templates, w)
+                ex, ey = _synthetic_images(
+                    rng, cfg.synthetic_test_size, templates, w
+                )
             source = "synthetic"
         else:
             raise FileNotFoundError(

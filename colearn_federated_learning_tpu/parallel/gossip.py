@@ -66,17 +66,37 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
                          num_clients: int, gamma: float = 1.0 / 3.0,
                          mixing_steps: int = 1, topology: str = "ring",
                          donate: bool = True, local_dtype=None,
-                         scan_unroll: int = 1):
+                         scan_unroll: int = 1, cohort_size: int = 0):
     """Build the jitted one-program gossip round.
 
-    Signature of the returned fn::
+    Signature of the returned fn (full participation,
+    ``cohort_size`` 0 or == N)::
 
         (replicas [N, ...] client-sharded, train_x, train_y,
          idx [N,steps,batch], mask [N,steps,batch], n_ex [N], rng)
         → (new_replicas, mean_params, GossipMetrics)
 
+    **Partial participation** (``cohort_size`` = K < N, r5 — what makes
+    gossip schedulable beyond toy N): only the K sampled clients train;
+    everyone still mixes. The local phase costs O(K·steps) compute
+    instead of O(N·steps): the cohort's replica rows are GATHERED from
+    the client-sharded stack in-program (take-with-fill + one psum —
+    each row owned by exactly one lane, the state-store pattern from
+    round_engine.py), trained cohort-sharded, and scattered back
+    (all_gather + windowed in-shard write, OOB drops). Signature gains
+    trailing ``cohort_ids [K]`` (replicated) and idx/mask/n_ex/keys
+    become ``[K, ...]`` cohort-sharded::
+
+        (replicas, train_x, train_y, idx [K,steps,batch],
+         mask [K,steps,batch], n_ex [K], rng, cohort_ids [K])
+        → (new_replicas, mean_params, GossipMetrics)
+
+    Replica-stack memory stays O(N·|params|/lanes) — partial
+    participation cuts compute, not storage; the driver's HBM
+    pre-flight guards the stack itself.
+
     ``num_clients`` must divide evenly over the mesh's client lanes
-    (every client trains every round — there are no pad rows to hide).
+    (there are no pad rows to hide); so must ``cohort_size``.
     """
     if topology not in ("ring", "full"):
         raise ValueError(f"unknown gossip topology {topology!r}")
@@ -100,6 +120,19 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             f"(every client trains every round — no pad rows)"
         )
     rows = num_clients // n_lanes
+    if cohort_size in (0, num_clients):
+        cohort_size = 0  # full participation: the classic path
+    elif not 0 < cohort_size < num_clients:
+        raise ValueError(
+            f"gossip cohort_size {cohort_size} must be in (0, "
+            f"num_clients={num_clients}]"
+        )
+    elif cohort_size % n_lanes != 0:
+        raise ValueError(
+            f"gossip cohort_size {cohort_size} not divisible by "
+            f"{n_lanes} lanes"
+        )
+    k_rows = cohort_size // n_lanes if cohort_size else 0
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task, local_dtype=local_dtype,
         scan_unroll=scan_unroll,
@@ -110,8 +143,9 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
     fwd = [(i, (i + 1) % n_lanes) for i in range(n_lanes)]
     bwd = [(i, (i - 1) % n_lanes) for i in range(n_lanes)]
 
-    def lane_fn(replicas, train_x, train_y, idx, mask, n_ex, keys):
-        # --- local phase: each row trains from ITS OWN params ---------
+    def lane_fn(replicas, train_x, train_y, idx, mask, n_ex, keys,
+                cohort_ids=None):
+        # --- local phase ----------------------------------------------
         def per_row(_, inp):
             r_params, r_idx, r_mask, r_key = inp
             w, m = local_train(r_params, train_x, train_y, r_idx, r_mask, r_key)
@@ -122,9 +156,45 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             )
             return 0.0, (w, m.loss)
 
-        _, (trained, losses) = jax.lax.scan(
-            per_row, 0.0, (replicas, idx, mask, keys)
-        )
+        if cohort_size:
+            # partial participation: gather the cohort's replica rows
+            # (each owned by exactly one lane ⇒ the psum superposition
+            # is exact), train only those, scatter back
+            lane = jax.lax.axis_index(CLIENT_AXIS)
+            pos = cohort_ids - lane * rows  # [K]; OOB = not owned
+            pos = jnp.where(pos >= 0, pos, rows)
+            gathered = jax.tree.map(
+                lambda a: jax.lax.psum(
+                    jnp.take(a, pos, axis=0, mode="fill", fill_value=0)
+                    .astype(jnp.float32),
+                    CLIENT_AXIS,
+                ),
+                replicas,
+            )
+            chunk = jax.tree.map(
+                lambda a, r: jax.lax.dynamic_slice_in_dim(
+                    a, lane * k_rows, k_rows, 0
+                ).astype(r.dtype),
+                gathered, replicas,
+            )
+            _, (trained_chunk, losses) = jax.lax.scan(
+                per_row, 0.0, (chunk, idx, mask, keys)
+            )
+            trained_full = jax.tree.map(
+                lambda t: jax.lax.all_gather(
+                    t, CLIENT_AXIS, axis=0, tiled=True
+                ),
+                trained_chunk,
+            )
+            trained = jax.tree.map(
+                lambda a, nn: a.at[pos].set(nn.astype(a.dtype), mode="drop"),
+                replicas, trained_full,
+            )
+        else:
+            # full participation: every row trains from its own params
+            _, (trained, losses) = jax.lax.scan(
+                per_row, 0.0, (replicas, idx, mask, keys)
+            )
 
         # --- gossip phase: mixing_steps sweeps of W -------------------
         def sweep_ring(tree):
@@ -196,17 +266,21 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             "consensus": dist,
         }
 
+    in_specs = (P(CLIENT_AXIS), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                P(CLIENT_AXIS), P(CLIENT_AXIS))
+    if cohort_size:
+        in_specs += (P(),)  # cohort ids, replicated
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
-        in_specs=(P(CLIENT_AXIS), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
-                  P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        in_specs=in_specs,
         out_specs=(P(CLIENT_AXIS), P(), {"loss": P(), "n": P(),
                                          "consensus": P()}),
     )
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def round_fn(replicas, train_x, train_y, idx, mask, n_ex, rng):
+    def round_fn(replicas, train_x, train_y, idx, mask, n_ex, rng,
+                 cohort_ids=None):
         for leaf in jax.tree.leaves(replicas):
             if leaf.shape[0] != num_clients:
                 raise ValueError(
@@ -215,8 +289,13 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
                 )
             break
         keys = jax.random.split(rng, idx.shape[0])
+        extra = ()
+        if cohort_size:
+            if cohort_ids is None:
+                raise TypeError("partial gossip requires cohort_ids")
+            extra = (cohort_ids,)
         mixed, mean_params, out = sharded_lane(
-            replicas, train_x, train_y, idx, mask, n_ex, keys
+            replicas, train_x, train_y, idx, mask, n_ex, keys, *extra
         )
         return mixed, mean_params, GossipMetrics(
             out["loss"], out["n"], out["consensus"]

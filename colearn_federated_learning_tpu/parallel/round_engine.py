@@ -1321,6 +1321,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     update = jax.jit(server_update)
 
     use_decay = client_cfg.lr_decay != 1.0
+    # ONE jit wrapper per factory: eager per-client pairwise uploads
+    # re-trace the K-step PRG scan every call (~seconds each), and a
+    # wrapper created inside round_fn would re-compile every ROUND —
+    # the cache lives with the wrapper, so it must outlive the round
+    pairwise_up = (
+        jax.jit(_secagg_pairwise_upload, static_argnums=(7, 8))
+        if secagg and secagg_mode == "pairwise" else None
+    )
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
                  c_global=None, c_cohort=None, pair_seeds=None):
@@ -1350,11 +1358,6 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 if pair_seeds is None:
                     raise TypeError("secagg_mode='pairwise' requires pair_seeds")
                 part_full = jnp.asarray(n_ex) > 0
-                # eager per-client calls re-trace the K-step PRG scan
-                # every time (~seconds each); jit it once per shape
-                pairwise_up = jax.jit(
-                    _secagg_pairwise_upload, static_argnums=(7, 8)
-                )
             else:
                 mask_key = jax.random.fold_in(rng, _SECAGG_FOLD)
             q_acc = jax.tree.map(

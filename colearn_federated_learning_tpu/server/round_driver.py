@@ -122,6 +122,14 @@ class Experiment:
         # mixing. state["params"] tracks the consensus mean (what eval/
         # checkpoint-export consume); state["replicas"] is the stack.
         self.gossip = cfg.algorithm == "gossip"
+        # partial-participation gossip (r5): K < N ⇒ the sampled cohort
+        # trains (in-program gather/scatter over the replica stack),
+        # everyone mixes; 0 = classic full participation
+        self._gossip_partial = (
+            cfg.server.cohort_size
+            if self.gossip and cfg.server.cohort_size < cfg.data.num_clients
+            else 0
+        )
         # secure aggregation (ServerConfig.secure_aggregation): masks
         # ride a STATIC full-cohort ring; the fixed-point range checks
         # run after the aggregation-weight mode is resolved below
@@ -196,6 +204,7 @@ class Experiment:
                     topology=cfg.server.gossip_topology,
                     local_dtype=self._local_dtype(),
                     scan_unroll=cfg.run.scan_unroll,
+                    cohort_size=cfg.server.cohort_size,
                 )
             elif self.fedbuff:
                 self.round_fn = make_async_round_fn(
@@ -293,6 +302,7 @@ class Experiment:
         # copies instead of device_put-ing across processes.
         put = self._put_data
         self._stream = cfg.data.placement == "stream"
+        self._check_memory_budget()
         self._prefetch: Dict[int, Any] = {}
         self._host_executor = None
         if self._stream:
@@ -457,6 +467,92 @@ class Experiment:
                     f"server.secagg_allow_wrap_risk=true to accept the "
                     f"risk explicitly"
                 )
+
+    def _param_bytes(self) -> int:
+        """Bytes of one params tree at run.param_dtype, via eval_shape
+        (no compute, no device memory — shapes only)."""
+        from colearn_federated_learning_tpu.client.trainer import (
+            normalize_input,
+        )
+
+        dummy = jax.ShapeDtypeStruct(
+            (1,) + self.fed.train_x.shape[1:],
+            self.fed.train_x.dtype,  # LM corpora are int tokens — an
+            # f32 dummy would crash nn.Embed's integer check
+        )
+        shapes = jax.eval_shape(
+            lambda d: self.model.init(
+                jax.random.PRNGKey(0), normalize_input(d), train=False
+            )["params"],
+            dummy,
+        )
+        return sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree.leaves(shapes)
+        )
+
+    def _check_memory_budget(self) -> None:
+        """Construction-time HBM pre-flight (VERDICT r4 missing-#4):
+        estimate the PERSISTENT per-device footprint and fail fast with
+        an actionable breakdown when it exceeds the budget. At the
+        north-star scales the N·|params| stacks dominate: gossip
+        N=1000 × ResNet-18 is ~44 GB f32 on one lane — impossible on a
+        16 GB chip, and without this check the failure is an opaque
+        RESOURCE_EXHAUSTED minutes into compilation. Transients
+        (activations, collective buffers) are NOT modeled; the check is
+        a lower bound on usage, so exceeding it is definitely fatal."""
+        budget_gb = self.cfg.run.hbm_gb
+        if budget_gb < 0:
+            return
+        if budget_gb == 0:
+            stats = jax.devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                budget_gb = stats["bytes_limit"] / 2**30
+            elif jax.devices()[0].platform == "cpu":
+                return  # host RAM; no meaningful fixed budget
+            else:
+                budget_gb = 16.0  # TPU v5e default; override via run.hbm_gb
+        gib = float(2**30)
+        p_bytes = self._param_bytes()
+        lanes = self.mesh.shape[mesh_lib.CLIENT_AXIS] if self.mesh else 1
+        parts: Dict[str, float] = {}
+        if not self._stream:
+            parts["corpus (replicated)"] = (
+                self.fed.train_x.nbytes + self.fed.train_y.nbytes
+            ) / gib
+        opt_factor = {"mean": 0, "fedavgm": 1, "fedadam": 2, "fedyogi": 2}[
+            self.cfg.server.optimizer
+        ]
+        parts["params + server opt"] = p_bytes * (1 + opt_factor) / gib
+        state_itemsize = (
+            2 if self.cfg.server.client_state_dtype == "bfloat16" else 4
+        )
+        if self.store_state:
+            rows = self._state_rows / lanes
+            n_trees = 1 + (1 if self.stateful else 0)  # store (+ c_global)
+            parts["per-client state store / lane"] = (
+                rows * p_bytes * state_itemsize / 4 * n_trees / gib
+            )
+        if self.gossip:
+            parts["gossip replica stack / lane"] = (
+                (self.fed.num_clients / lanes) * p_bytes / gib
+            )
+        if self.fedbuff:
+            window = 2 * self.cfg.server.async_max_staleness + 1
+            parts["fedbuff history ring"] = window * p_bytes / gib
+        total = sum(parts.values())
+        if total > 0.9 * budget_gb:
+            breakdown = "; ".join(f"{k}: {v:.2f} GiB" for k, v in parts.items())
+            raise ValueError(
+                f"persistent HBM footprint ≈ {total:.2f} GiB exceeds 90% "
+                f"of the {budget_gb:.1f} GiB device budget ({breakdown}). "
+                f"Remedies: data.placement=stream (drops the replicated "
+                f"corpus), server.client_state_dtype=bfloat16 (halves the "
+                f"state store), more mesh lanes (stacks shard over "
+                f"lanes), fewer clients, or a smaller model. Set "
+                f"run.hbm_gb to adjust the budget or -1 to disable this "
+                f"check."
+            )
 
     def _local_dtype(self):
         d = self.cfg.run.local_param_dtype
@@ -626,11 +722,13 @@ class Experiment:
         """All host-side work for one round: sampling, index construction,
         dropout weights, and (stream mode) the slab gather. Pure in
         (seed, round) — safe to run ahead on a worker thread."""
-        if self.gossip:
-            # no sampling: row i of the round tensors IS client i (the
-            # ring order is the client-id order, every round)
+        if self.gossip and self._gossip_partial == 0:
+            # full participation: row i of the round tensors IS client i
+            # (the ring order is the client-id order, every round)
             cohort = np.arange(self.fed.num_clients, dtype=np.int64)
         else:
+            # centralized cohorts, or partial-participation gossip's
+            # per-round active subset (uniform without replacement)
             cohort = self.sampler.sample(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
         if self._native is not None:
@@ -878,8 +976,15 @@ class Experiment:
          n_host) = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         if self.gossip:
+            extra = ()
+            if self._gossip_partial:
+                extra = (self._put(
+                    jnp.asarray(np.asarray(cohort, np.int32)),
+                    self._data_sharding,
+                ),)
             replicas, mean_params, metrics = self.round_fn(
                 state["replicas"], train_x, train_y, idx, mask, n_ex, rng,
+                *extra,
             )
             return {
                 "params": mean_params,
@@ -911,18 +1016,26 @@ class Experiment:
                 *head, c_clients, metrics = out
             else:
                 # sequential oracle: host-resident numpy store with an
-                # explicit per-round gather/scatter
+                # explicit per-round gather/scatter. Poisson pad slots
+                # carry id == num_clients (OOB by construction): gather
+                # reads row 0 in their place (harmless — pad rows are
+                # fully masked) and the scatter SKIPS them, mirroring
+                # the sharded engine's take-fill/scatter-drop semantics.
+                rows = np.asarray(cohort)
+                real = rows < self.fed.num_clients
+                safe = np.where(real, rows, 0)
                 c_cohort = jax.tree.map(
-                    lambda a: jnp.asarray(a[cohort]), state["c_clients"]
+                    lambda a: jnp.asarray(a[safe]), state["c_clients"]
                 )
                 out = self.round_fn(
                     *common, *(glob or (None,)), c_cohort,
                 )
                 *head, new_c_cohort, metrics = out
                 fetched = jax.device_get(new_c_cohort)
-                rows = np.asarray(cohort)
                 jax.tree.map(
-                    lambda store, f: store.__setitem__(rows, f),
+                    lambda store, f: store.__setitem__(
+                        rows[real], f[real]
+                    ),
                     state["c_clients"], fetched,
                 )
                 c_clients = state["c_clients"]

@@ -1,0 +1,117 @@
+"""Generate the checked-in LEARNABLE micro-corpora in the real on-disk
+formats (VERDICT r4 missing-#1 / next-#7).
+
+Unlike the random bytes in tests/test_real_loaders.py (which prove the
+loaders PARSE), these fixtures prove the real data path LEARNS: each
+corpus carries class structure (template images / predictable text) so
+``Experiment.fit`` through loader → partition → round engine reaches a
+pinned accuracy band (tests/test_fixture_convergence.py, slow-marked).
+
+Deterministic: re-running this script reproduces the committed files
+byte-for-byte (fixed seeds, no timestamps). Run from the repo root:
+
+    python tests/fixtures/make_fixtures.py
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _template_images(rng, n, templates, w=0.7):
+    """Class-template images, the synthetic generator's recipe but
+    emitted as REAL files: x = w·T_class + (1−w)·noise, uint8. The
+    caller passes ONE template set shared by train and test — that
+    sharing is what makes test accuracy reflect learning."""
+    num_classes = templates.shape[0]
+    y = rng.integers(0, num_classes, n)
+    noise = rng.uniform(0, 1, (n,) + templates.shape[1:])
+    x = w * templates[y] + (1 - w) * noise
+    return (x * 255).astype(np.uint8), y
+
+
+def make_mnist():
+    rng = np.random.default_rng(1001)
+    templates = rng.uniform(0, 1, (10, 28, 28))
+    x_train, y_train = _template_images(rng, 400, templates)
+    x_test, y_test = _template_images(rng, 100, templates)
+    np.savez(
+        os.path.join(HERE, "mnist", "mnist.npz"),
+        x_train=x_train, y_train=y_train.astype(np.uint8),
+        x_test=x_test, y_test=y_test.astype(np.uint8),
+    )
+
+
+def make_cifar10():
+    rng = np.random.default_rng(1002)
+    base = os.path.join(HERE, "cifar10", "cifar-10-batches-py")
+    os.makedirs(base, exist_ok=True)
+    templates = rng.uniform(0, 1, (10, 3, 32, 32))
+    for i in range(1, 6):
+        x, y = _template_images(rng, 48, templates)
+        with open(os.path.join(base, f"data_batch_{i}"), "wb") as f:
+            pickle.dump(
+                {b"data": x.reshape(48, 3072), b"labels": y.tolist()}, f
+            )
+    x, y = _template_images(rng, 60, templates)
+    with open(os.path.join(base, "test_batch"), "wb") as f:
+        pickle.dump({b"data": x.reshape(60, 3072), b"labels": y.tolist()}, f)
+
+
+def make_femnist():
+    """LEAF all_data.json: 8 writers, each biased toward 3 of the 62
+    classes (the natural non-IID structure), template images quantized
+    to 2 decimals to keep the JSON small."""
+    rng = np.random.default_rng(1003)
+    templates = rng.uniform(0, 1, (62, 784))
+    users, num_samples, user_data = [], [], {}
+    for u in range(8):
+        name = f"writer_{u:02d}"
+        classes = rng.choice(62, size=3, replace=False)
+        y = rng.choice(classes, size=48)
+        noise = rng.uniform(0, 1, (48, 784))
+        x = np.round(0.7 * templates[y] + 0.3 * noise, 2)
+        users.append(name)
+        num_samples.append(48)
+        user_data[name] = {"x": x.tolist(), "y": y.tolist()}
+    blob = {"users": users, "num_samples": num_samples,
+            "user_data": user_data}
+    os.makedirs(os.path.join(HERE, "femnist", "femnist"), exist_ok=True)
+    with open(os.path.join(HERE, "femnist", "femnist", "all_data.json"),
+              "w") as f:
+        json.dump(blob, f)
+
+
+def make_shakespeare():
+    """Predictable per-speaker text: each block repeats one catchphrase
+    — a char-LM that learns anything beats the unigram floor fast."""
+    rng = np.random.default_rng(1004)
+    phrases = [
+        "the quick brown fox jumps over the lazy dog. ",
+        "to be or not to be that is the question. ",
+        "all the world is a stage and we are players. ",
+        "now is the winter of our discontent made summer. ",
+        "what light through yonder window breaks softly. ",
+        "once more unto the breach dear friends once more. ",
+    ]
+    blocks = []
+    for i, ph in enumerate(phrases):
+        reps = int(rng.integers(28, 36))
+        blocks.append(f"SPEAKER {i}:\n" + ph * reps)
+    with open(os.path.join(HERE, "shakespeare", "shakespeare.txt"),
+              "w") as f:
+        f.write("\n\n".join(blocks))
+
+
+if __name__ == "__main__":
+    for sub in ("mnist", "cifar10", "femnist", "shakespeare"):
+        os.makedirs(os.path.join(HERE, sub), exist_ok=True)
+    make_mnist()
+    make_cifar10()
+    make_femnist()
+    make_shakespeare()
+    print("fixtures written under", HERE)

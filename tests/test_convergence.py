@@ -132,3 +132,55 @@ def test_cifar10_fedavg_1000_converges(tmp_path):
     # shared synthetic class structure must already lift accuracy well
     # off chance (0.10); a scale-path bug plateaus at chance
     assert ev["eval_acc"] >= 0.5, ev
+
+
+def _pair_cfg(tmp_path):
+    """Second task family (VERDICT r4 weak-#4): template_pair — two
+    superposed strokes, label = (a+b) mod 10. A linear model's additive
+    pixel scores cap near chance (measured linear probe: 0.12) while
+    the convnet detects strokes and learns the nonlinear readout, so
+    regressions that only hurt non-linearly-separable structure (which
+    the template family cannot see) move THIS curve. Label noise 0.1
+    sets a strict ceiling below 1; iid partition (the first family
+    already pins the Dirichlet path)."""
+    cfg = get_named_config("cifar10_fedavg_100")
+    cfg.apply_overrides({
+        "data.num_clients": 32,
+        "data.synthetic_train_size": 2048,
+        "data.synthetic_test_size": 512,
+        "data.max_examples_per_client": 64,
+        "data.partition": "iid",
+        "data.synthetic_task": "template_pair",
+        "data.synthetic_template_weight": 0.85,
+        "data.synthetic_label_noise": 0.1,
+        "model.kwargs.width": 8,
+        "server.num_rounds": 24,
+        "server.cohort_size": 8,
+        "server.eval_every": 4,
+        "client.batch_size": 32,
+        "run.out_dir": str(tmp_path),
+        "run.compute_dtype": "float32",
+        "run.local_param_dtype": "",
+        "run.metrics_flush_every": 4,
+    })
+    return cfg.validate()
+
+
+@pytest.mark.slow
+def test_template_pair_converges(tmp_path):
+    """Calibrated fixed-seed curve: 0.539 @r20 → 0.811 @r24 (the label
+    noise caps the ceiling near 0.9, so the task stays non-saturating).
+    Floor catches structure-sensitive regressions; ceiling asserts the
+    difficulty calibration didn't silently break."""
+    exp = Experiment(_pair_cfg(tmp_path), echo=False)
+    state = exp.fit()
+    ev = exp.evaluate(state["params"])
+    assert math.isfinite(ev["eval_loss"])
+    assert 0.60 <= ev["eval_acc"] <= 0.92, ev
+    curve = {
+        rec["round"]: rec["eval_acc"]
+        for rec in exp.logger.history
+        if "eval_acc" in rec
+    }
+    # learning must be underway well before the end
+    assert curve[24] > curve[8] + 0.25, curve
