@@ -35,8 +35,12 @@ BASELINES = {
     # round-3 first measurements through THIS bench path (BASELINE.md
     # round-3 table; the dispatch-bound configs vary ~2× with relay load)
     "cifar10_fedavg_1000": 3.05,
-    "femnist_fedprox_500": 5.90,
-    "shakespeare_fedavg": 6.71,
+    # femnist/shakespeare RE-PINNED at the r5-adopted shapes (cohort 32;
+    # shakespeare also fuse_rounds=10) — BASELINE.md r5 sweep table. The
+    # old-shape values (5.90 / 6.71 at cohorts 16 / 8) are kept there;
+    # client-updates/sec/chip improved 337→405 and 381→801.
+    "femnist_fedprox_500": 12.66,
+    "shakespeare_fedavg": 13.42,
     "imagenet_silo_dp": 0.31,
 }
 
@@ -47,11 +51,12 @@ BASELINES = {
 # gates on the round program's measured DEVICE time instead, which is
 # weather-independent (VERDICT r3 weak-#5).
 DEVICE_MS_BASELINES = {
-    # r4 first measurements (BASELINE.md r4 width-sweep table): femnist
-    # at its fastest width (1), shakespeare at its adopted width (0 =
-    # full lane)
-    "femnist_fedprox_500": 32.6,
-    "shakespeare_fedavg": 6.2,
+    # RE-PINNED r5 at the adopted shapes (BASELINE.md r5): femnist
+    # cohort 32 (per-update device flat vs r4's 32.6 @ cohort 16),
+    # shakespeare cohort 32 + fuse 10 (ms per ROUND; the fused chunk is
+    # divided by fuse in _measure_device_ms)
+    "femnist_fedprox_500": 64.6,
+    "shakespeare_fedavg": 29.5,
 }
 
 # gate on device time only when the MXU is starved; above this the wall
@@ -73,7 +78,9 @@ _SHAPES = {
     "cifar10_fedavg_100": (2, 16, {}),
     "cifar10_fedavg_1000": (2, 8, {}),
     "femnist_fedprox_500": (2, 8, {}),
-    "shakespeare_fedavg": (2, 16, {}),
+    # shakespeare runs fused (run.fuse_rounds=10): warmup/timed are
+    # fused-chunk multiples
+    "shakespeare_fedavg": (10, 20, {}),
     "imagenet_silo_dp": (1, 3, {"data.max_examples_per_client": 128}),
 }
 
@@ -155,15 +162,18 @@ def _measure_device_ms(exp, state, start_round: int, rounds: int = 4):
     import jax
 
     tmp = tempfile.mkdtemp(prefix="bench_profile_")
+    fuse = exp.cfg.run.fuse_rounds
     try:
         jax.profiler.start_trace(tmp)
         pending = []
-        for r in range(start_round, start_round + rounds):
+        for r in range(start_round, start_round + rounds * fuse, fuse):
             state = exp.run_round(state, r)
             pending.append(state.pop("_metrics"))
         jax.device_get(pending)
         jax.profiler.stop_trace()
-        return state, _parse_device_ms(tmp)
+        ms = _parse_device_ms(tmp)
+        # ``rounds`` DISPATCHES; under fusion each carries fuse rounds
+        return state, (ms / fuse if ms is not None else None)
     except Exception:
         try:
             jax.profiler.stop_trace()
@@ -242,15 +252,28 @@ def bench_config(name: str):
     # ends with ONE metrics drain, which forces execution of every round
     # (each depends on the previous round's params). block_until_ready
     # alone does not sync through the axon remote-execution relay.
-    for r in range(warmup):
+    fuse = cfg.run.fuse_rounds
+    # each dispatch executes exactly `fuse` rounds — misaligned shape
+    # constants would silently mis-count rounds_per_sec
+    assert warmup % fuse == 0 and timed % fuse == 0, (name, warmup, timed, fuse)
+    for r in range(0, warmup, fuse):
         state = exp.run_round(state, r)
-        last_loss = float(state.pop("_metrics").train_loss)
+        m = state.pop("_metrics")
+        last_loss = float(
+            m.train_loss if fuse == 1 else m.train_loss[-1]
+        )
 
     t0 = time.perf_counter()
     pending = []
-    for r in range(warmup, warmup + timed):
+    for r in range(warmup, warmup + timed, fuse):
         state = exp.run_round(state, r)
-        pending.append(state.pop("_metrics"))
+        m = state.pop("_metrics")
+        if fuse == 1:
+            pending.append(m)
+        else:
+            pending.extend(
+                jax.tree.map(lambda a, j=j: a[j], m) for j in range(fuse)
+            )
     fetched = jax.device_get(pending)
     last_loss = float(fetched[-1].train_loss)
     dt = time.perf_counter() - t0

@@ -104,6 +104,12 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
             g_sum = jax.tree.map(lambda g: jax.lax.psum(g, batch_axis), g_sum)
             loss_sum = jax.lax.psum(loss_sum, batch_axis)
             n = jax.lax.psum(n, batch_axis)
+        return _noise_and_mean(params, g_sum, loss_sum, n, rng)
+
+    def _noise_and_mean(params, g_sum, loss_sum, n, rng):
+        """Shared mechanism tail: Gaussian noise on the CLIPPED SUM,
+        then the fixed-denominator mean — identical for both clipping
+        strategies (they differ only in how Σ sᵢ·gᵢ is computed)."""
         denom = jnp.maximum(n, 1.0)
         keys = jax.random.split(rng, len(jax.tree.leaves(params)))
         keys = jax.tree.unflatten(jax.tree.structure(params), list(keys))
@@ -121,6 +127,84 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
         )
         return loss_sum / denom, noisy
 
+    def dp_grads_two_pass(params, x, y, m, rng):
+        """Ghost-norm-style exact clipping in its JAX-native form
+        (VERDICT r4 missing-#5): the expensive part of `dp_grads` is
+        that vmap(grad)'s per-example backward cannot use full-batch
+        matmuls. Instead:
+
+        - **Pass 1 (norms)**: per-example gradient NORMS only, via the
+          same microbatched vmap(grad) but with the grads reduced to
+          squared norms inside the vmapped function — XLA never has to
+          keep (let alone accumulate) per-example weight-grad trees,
+          which lifts the microbatch-size memory ceiling.
+        - **Pass 2 (weighted)**: the clipped sum Σ sᵢ·gᵢ is the gradient
+          of ONE fully batched backward: loss_fn is the s-weighted mean
+          Σ sᵢ·lᵢ / Σ sᵢ, and multiplying its gradient by the
+          θ-independent Σ sᵢ yields exactly Σ sᵢ·gᵢ.
+
+        Two backwards total, but both MXU-batched — a win whenever the
+        vmapped backward is > 2× the batched one (measured on the ViT
+        silo config: BASELINE.md r5). The released quantity is
+        IDENTICAL to the microbatch path (same clip scales, same noise
+        stream), so the accountant is untouched; parity is test-pinned.
+        """
+        if batch_axis is not None:
+            vparams = jax.tree.map(
+                lambda p: jax.lax.pcast(p, (batch_axis,), to="varying"), params
+            )
+        else:
+            vparams = params
+        b = x.shape[0]
+        mb = max(1, min(cfg.microbatch_size, b))
+        n_micro = b // mb
+        assert n_micro * mb == b, (
+            f"batch {b} not divisible by microbatch {mb}"
+        )
+        xm = x.reshape((n_micro, mb) + x.shape[1:])
+        ym = y.reshape((n_micro, mb) + y.shape[1:])
+
+        def example_sqnorm(x1, y1):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                vparams, x1[None], y1[None], jnp.ones((1,), jnp.float32)
+            )
+            sq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+            return loss, sq
+
+        def norm_micro(_, inp):
+            xs, ys = inp
+            losses, sqs = jax.vmap(example_sqnorm)(xs, ys)
+            return 0.0, (losses, sqs)
+
+        _, (losses, sqnorms) = jax.lax.scan(norm_micro, 0.0, (xm, ym))
+        losses = losses.reshape(b)
+        norms = jnp.sqrt(sqnorms.reshape(b))
+        # clip scales in f32 (privacy-critical, as in the microbatch path)
+        scale = jnp.minimum(1.0, cfg.l2_clip / jnp.maximum(norms, 1e-12)) * m
+        # pass 2: one batched weighted backward. loss_fn(mask=scale) is
+        # Σ sᵢ·lᵢ / max(Σ sᵢ, 1) (the masked-mean contract every loss in
+        # this codebase follows — the same max-with-1 floor as the
+        # engines' degenerate denominators); the denominator does not
+        # depend on θ, so scaling the gradient by the SAME floored value
+        # recovers the clipped SUM exactly, including when Σ sᵢ < 1.
+        s_den = jnp.maximum(scale.sum(), 1.0)
+        _, g_mean = jax.value_and_grad(loss_fn)(vparams, x, y, scale)
+        g_sum = jax.tree.map(
+            lambda g: g.astype(jnp.float32) * s_den, g_mean
+        )
+        loss_sum = (losses * m).sum()
+        n = m.sum()
+        if batch_axis is not None:
+            g_sum = jax.tree.map(lambda g: jax.lax.psum(g, batch_axis), g_sum)
+            loss_sum = jax.lax.psum(loss_sum, batch_axis)
+            n = jax.lax.psum(n, batch_axis)
+        return _noise_and_mean(params, g_sum, loss_sum, n, rng)
+
+    if getattr(cfg, "clipping", "microbatch") == "two_pass":
+        return dp_grads_two_pass
     return dp_grads
 
 

@@ -244,6 +244,7 @@ class Experiment:
                     downlink=cfg.server.downlink_compression,
                     downlink_levels=cfg.server.downlink_qsgd_levels,
                     error_feedback=self.ef,
+                    fuse_rounds=cfg.run.fuse_rounds,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -303,6 +304,16 @@ class Experiment:
         put = self._put_data
         self._stream = cfg.data.placement == "stream"
         self._check_memory_budget()
+        if cfg.run.fuse_rounds > 1 and jax.process_count() > 1:
+            # the fused branch stacks cohort-sharded GLOBAL arrays
+            # host-side (jnp.stack), which multi-process runs cannot
+            # address; config.validate cannot see the process count, so
+            # guard here (the store_state precedent above)
+            raise NotImplementedError(
+                "run.fuse_rounds > 1 is single-process only (the fused "
+                "input stacking is host-side); set fuse_rounds=1 under "
+                "multi-host"
+            )
         self._prefetch: Dict[int, Any] = {}
         self._host_executor = None
         if self._stream:
@@ -1050,6 +1061,33 @@ class Experiment:
             if self.stateful:
                 new_state["c_global"] = head[2]
             return new_state
+        fuse = self.cfg.run.fuse_rounds
+        if fuse > 1:
+            # stack this chunk's rounds (round_idx is chunk-aligned by
+            # the fit loop); per-round rngs are EXACTLY the unfused
+            # loop's derivations, so fused ≡ unfused bitwise
+            chunks = [(idx, mask, n_ex)]
+            rngs = [rng]
+            for j in range(1, fuse):
+                (_, i_j, m_j, n_j, tx_j, ty_j,
+                 _) = self._round_inputs(round_idx + j)
+                chunks.append((i_j, m_j, n_j))
+                rngs.append(jax.random.fold_in(state["rng_key"],
+                                               round_idx + j))
+            stack = lambda xs: jnp.stack([jnp.asarray(x) for x in xs])  # noqa: E731
+            params, opt_state, metrics = self.round_fn(
+                state["params"], state["server_opt_state"], train_x,
+                train_y, stack([c[0] for c in chunks]),
+                stack([c[1] for c in chunks]),
+                stack([c[2] for c in chunks]), jnp.stack(rngs),
+            )
+            return {
+                "params": params,
+                "server_opt_state": opt_state,
+                "round": round_idx + fuse,
+                "rng_key": state["rng_key"],
+                "_metrics": metrics,
+            }
         kw = {}
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
             kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
@@ -1309,32 +1347,45 @@ class Experiment:
             pending.clear()
             flush_t0 = time.perf_counter()
 
-        for r in range(start_round, cfg.server.num_rounds):
+        fuse = cfg.run.fuse_rounds if not (
+            self.fedbuff or self.gossip or self.store_state
+        ) else 1
+        for r in range(start_round, cfg.server.num_rounds, fuse):
             profiling = r == cfg.run.profile_round
             if profiling:
                 flush(state)
                 jax.profiler.start_trace(os.path.join(self._run_dir(), "profile"))
             state = self.run_round(state, r)
-            pending.append((r, state.pop("_metrics")))
+            ms = state.pop("_metrics")
+            if fuse == 1:
+                pending.append((r, ms))
+            else:
+                # [F]-stacked fields from the fused scan: tiny device
+                # slices, drained at the same flush boundaries
+                pending.extend(
+                    (r + j, jax.tree.map(lambda a, j=j: a[j], ms))
+                    for j in range(fuse)
+                )
             if profiling:
                 # A scalar fetch, not block_until_ready: on a relayed chip
                 # only a device_get truly forces execution, and the trace
                 # must contain the round's device compute.
                 jax.device_get(pending[-1][1].train_loss)
                 jax.profiler.stop_trace()
-            at_eval = cfg.server.eval_every and (r + 1) % cfg.server.eval_every == 0
-            at_ckpt = store and cfg.server.checkpoint_every and (r + 1) % cfg.server.checkpoint_every == 0
-            if len(pending) >= flush_every or at_eval or at_ckpt or r + 1 == cfg.server.num_rounds:
+            r_end = r + fuse  # validate() pins eval/ckpt to chunk ends
+            at_eval = cfg.server.eval_every and r_end % cfg.server.eval_every == 0
+            at_ckpt = store and cfg.server.checkpoint_every and r_end % cfg.server.checkpoint_every == 0
+            if len(pending) >= flush_every or at_eval or at_ckpt or r_end == cfg.server.num_rounds:
                 flush(state)
             if cfg.run.sanitize:
                 finite = all(
                     bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(state["params"])
                 )
                 if not finite:
-                    raise FloatingPointError(f"non-finite params after round {r + 1}")
+                    raise FloatingPointError(f"non-finite params after round {r_end}")
             if at_ckpt:
                 self._write_state_kind()
-                store.save(r + 1, state)
+                store.save(r_end, state)
                 flush_t0 = time.perf_counter()  # keep save time out of the next window
         flush(state)
         state["wall_time"] = time.perf_counter() - t_start

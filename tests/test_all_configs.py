@@ -29,6 +29,9 @@ _SHRINK = {
     "shakespeare_fedavg": {
         "data.num_clients": 16,
         "model.kwargs.seq_len": 16,
+        # the smoke shrinks num_rounds below the adopted fuse chunk;
+        # fusion itself is pinned by tests/test_round_engine.py
+        "run.fuse_rounds": 1,
     },
     # gossip: the blanket cohort shrink (min(cohort,4)) must keep
     # cohort == num_clients, so shrink the federation to 4 as well

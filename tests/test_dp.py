@@ -132,3 +132,57 @@ def test_rdp_accountant_subsampling_never_hurts():
         amp = dp_lib.rdp_epsilon(1.5, q, 500, 1e-5)
         unamp = dp_lib.rdp_epsilon(1.5, 1.0, 500, 1e-5)
         assert amp <= unamp + 1e-9, (q, amp, unamp)
+
+
+class TestTwoPassClipping:
+    """dp.clipping="two_pass" (ghost-norm-style, r5): the released
+    quantity must be IDENTICAL to the microbatch path — same clip
+    scales, same noise stream — only the schedule of backward passes
+    differs."""
+
+    def _both(self, cfg_kw, b=16, d=8, seed=0):
+        rng = np.random.default_rng(seed)
+        params = {"w": jnp.asarray(rng.normal(size=d).astype(np.float32))}
+        x = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32) * 10)
+        y = jnp.zeros(b)
+        m = jnp.asarray((rng.random(b) > 0.2).astype(np.float32))
+        outs = {}
+        for mode in ("microbatch", "two_pass"):
+            cfg = DPConfig(enabled=True, clipping=mode, **cfg_kw)
+            fn = jax.jit(dp_lib.make_dp_grad_fn(_quadratic_loss, cfg))
+            outs[mode] = fn(params, x, y, m, jax.random.PRNGKey(7))
+        return outs
+
+    def test_matches_microbatch_noiseless(self):
+        outs = self._both(dict(l2_clip=0.3, noise_multiplier=0.0,
+                               microbatch_size=4))
+        (l1, g1), (l2, g2) = outs["microbatch"], outs["two_pass"]
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+            g1, g2,
+        )
+
+    def test_matches_microbatch_with_noise(self):
+        """Same rng ⇒ the identical noise stream on both paths: outputs
+        agree to float tolerance even WITH noise."""
+        outs = self._both(dict(l2_clip=0.5, noise_multiplier=1.3,
+                               microbatch_size=8))
+        (_, g1), (_, g2) = outs["microbatch"], outs["two_pass"]
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g1, g2,
+        )
+
+    def test_clip_bound_still_exact(self):
+        cfg = DPConfig(enabled=True, clipping="two_pass", l2_clip=0.1,
+                       noise_multiplier=0.0, microbatch_size=4)
+        fn = jax.jit(dp_lib.make_dp_grad_fn(_quadratic_loss, cfg))
+        rng = np.random.default_rng(3)
+        params = {"w": jnp.asarray(rng.normal(size=8).astype(np.float32))}
+        x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32) * 100)
+        _, grads = fn(params, x, jnp.zeros(16), jnp.ones(16),
+                      jax.random.PRNGKey(0))
+        assert float(trees.tree_global_norm(grads)) <= cfg.l2_clip * 1.0001
