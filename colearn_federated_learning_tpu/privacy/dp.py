@@ -145,9 +145,18 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
 
         Two backwards total, but both MXU-batched — a win whenever the
         vmapped backward is > 2× the batched one (measured on the ViT
-        silo config: BASELINE.md r5). The released quantity is
-        IDENTICAL to the microbatch path (same clip scales, same noise
-        stream), so the accountant is untouched; parity is test-pinned.
+        silo config: BASELINE.md r5). Same clip scales, same noise
+        stream as the microbatch path; parity is test-pinned.
+
+        Sensitivity caveat (stated, not hidden): the clip NORMS come
+        from pass 1's per-example backwards while the released sum
+        comes from pass 2's batched backward, whose per-example
+        contributions can differ by floating-point reassociation —
+        ‖sᵢ·gᵢ‖ ≤ l2_clip then holds only up to that rounding
+        (f32: ~1e-6 relative; bf16 compute: up to ~1e-2). The
+        microbatch path clips the exact released values and is the
+        right choice when strict sensitivity matters — which is also
+        the measured-faster default.
         """
         if batch_axis is not None:
             vparams = jax.tree.map(

@@ -516,10 +516,16 @@ class Experiment:
         if budget_gb < 0:
             return
         if budget_gb == 0:
-            stats = jax.devices()[0].memory_stats()
+            # local_devices: under multi-process, jax.devices()[0] can
+            # belong to ANOTHER process and memory_stats then raises
+            dev = jax.local_devices()[0]
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
             if stats and stats.get("bytes_limit"):
                 budget_gb = stats["bytes_limit"] / 2**30
-            elif jax.devices()[0].platform == "cpu":
+            elif dev.platform == "cpu":
                 return  # host RAM; no meaningful fixed budget
             else:
                 budget_gb = 16.0  # TPU v5e default; override via run.hbm_gb
@@ -1350,6 +1356,16 @@ class Experiment:
         fuse = cfg.run.fuse_rounds if not (
             self.fedbuff or self.gossip or self.store_state
         ) else 1
+        if fuse > 1 and start_round % fuse:
+            # a warm-start/checkpoint at an unaligned round would shift
+            # every chunk boundary: evals/saves (validated as fuse
+            # multiples) would never fire and the last chunk would run
+            # past num_rounds — refuse instead of silently misbehaving
+            raise ValueError(
+                f"resume/warm-start round {start_round} is not a "
+                f"fuse_rounds={fuse} chunk boundary; set fuse_rounds=1 "
+                f"for this run or resume from an aligned checkpoint"
+            )
         for r in range(start_round, cfg.server.num_rounds, fuse):
             profiling = r == cfg.run.profile_round
             if profiling:

@@ -164,6 +164,7 @@ def test_lm_config_blockwise_attention_trains(tmp_path):
             "data.num_clients": 8,
             "server.cohort_size": 4,
             "server.num_rounds": 2,
+            "run.fuse_rounds": 1,  # smoke rounds < the adopted chunk
             "server.eval_every": 0,
             "client.batch_size": 8,
             "data.synthetic_train_size": 128,
