@@ -118,10 +118,10 @@ class ServerConfig:
     compression: str = ""
     compression_topk_ratio: float = 0.01
     compression_qsgd_levels: int = 256
-    # topk thresholds leaves > 65536 coords from a sampled quantile
-    # (selected count within ±10% of k; see ops/compression.py). True
-    # restores the exact full-sort threshold — 10× the training step's
-    # device time on ResNet-18-sized models (BASELINE.md r4/r5).
+    # topk thresholds leaves ≥ 2×65536 coords from a strided sampled
+    # quantile (selected count within ±10% of k; see ops/compression.py).
+    # True restores the exact full-sort threshold — 10× the training
+    # step's device time on ResNet-18-sized models (BASELINE.md r4/r5).
     compression_topk_exact: bool = False
     # Error-feedback compression memory (EF-SGD family — Seide et al.
     # 2014, Stich et al. 2018): each client keeps a persistent

@@ -10,11 +10,12 @@ with server optimizers — are reproduced exactly, even though on TPU the
 - ``topk``  — keep the ``ratio`` largest-magnitude coordinates per
   parameter tensor, zero the rest (Aji & Heafield 2017 style;
   deterministic, biased). Tie rule: threshold at the k-th largest
-  |value|, so exact ties at the threshold are all kept. For leaves
-  larger than ``_TOPK_SAMPLE`` coordinates the threshold is estimated
-  from a random coordinate subsample (one small sort + an O(n) apply)
-  instead of a full sort — see ``_TOPK_SAMPLE`` below for the
-  accuracy/cost analysis; ``exact=True`` restores the full sort.
+  |value|, so exact ties at the threshold are all kept. For leaves of
+  at least ``2×_TOPK_SAMPLE`` coordinates (the stride floor — below
+  that "sampling" would degenerate to a prefix) the threshold is
+  estimated from a strided coordinate subsample (one small sort + an
+  O(n) apply) instead of a full sort — see ``_TOPK_SAMPLE`` below for
+  the accuracy/cost analysis; ``exact=True`` restores the full sort.
 - ``qsgd``  — stochastic uniform quantization to ``levels`` levels per
   tensor (Alistarh et al. 2017): x → sign(x)·‖x‖₂·ξ/s with
   ξ = ⌊s·|x|/‖x‖₂ + u⌋, u ~ U[0,1). UNBIASED: E[output] = input — the
