@@ -23,6 +23,13 @@ Modes (5th arg, default ``fedavg``):
 - ``ef``       — error-feedback compression: the per-client residual
   store rides scaffold's cross-process store plumbing (no global
   state).
+- ``poisson``  — r5 Poisson sampling: every process builds the SAME
+  padded Binomial cohort host-side (pure (seed, round) rngs); pad
+  rows stay exact no-ops through the cross-process psum.
+- ``pairwise`` — r5 pairwise secagg: the DH seed matrix (with
+  Shamir-recovered dropped rows) is a replicated host input; the
+  per-pair mask scan's int32 cancellation survives the cross-process
+  psum.
 
 Run: multihost_fit_worker.py <pid> <nprocs> <port> <out_dir> [mode].
 """
@@ -93,6 +100,25 @@ def main():
             cfg.server.compression = "topk"
             cfg.server.compression_topk_ratio = 0.25
             cfg.server.error_feedback = True
+        elif mode == "poisson":
+            # r5: Binomial cohorts padded to the static cap; the pad
+            # tensors are built host-side from the SAME (seed, round)
+            # rng on every process, so the global arrays agree
+            cfg.data.num_clients = 16
+            cfg.server.sampling = "poisson"
+            cfg.server.dropout_rate = 0.2
+        elif mode == "pairwise":
+            # r5: pairwise-secagg seed matrix is a replicated host
+            # input (deterministic per round) — the mask scan and the
+            # Shamir-recovery rows must agree across processes
+            cfg.server.secure_aggregation = True
+            cfg.server.secagg_mode = "pairwise"
+            cfg.server.clip_delta_norm = 1.0
+            cfg.server.dropout_rate = 0.2
+        elif mode != "fedavg":
+            # a typo'd mode must not silently run the fedavg baseline
+            # and pass the caller's test vacuously
+            raise ValueError(f"unknown multihost fit mode {mode!r}")
         return cfg.validate()
 
     # phase 1: fresh 4-round fit with eval + periodic checkpoints

@@ -329,3 +329,45 @@ def test_two_process_stream_placement_fit(tmp_path):
     )
     assert parsed[0][1] == parsed[1][1] == "6", parsed
     assert parsed[0][2:] == parsed[1][2:], parsed
+
+
+@pytest.mark.multihost
+def test_two_process_poisson_fit(tmp_path):
+    """r5 Poisson sampling across a real process boundary: both
+    processes build the SAME padded Binomial cohorts host-side (pure
+    (seed, round) rngs), the padded rows stay exact no-ops through the
+    cross-process psum, and checkpoints/resume land on identical
+    params."""
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(str(tmp_path / "runs"), "poisson"),
+        timeout=600,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert {p[0] for p in parsed} == {"0", "1"}
+    assert all(p[1] == "6" for p in parsed)
+    assert parsed[0][2:] == parsed[1][2:], parsed
+
+
+@pytest.mark.multihost
+def test_two_process_pairwise_secagg_fit(tmp_path):
+    """r5 pairwise secagg across a real process boundary: the DH seed
+    matrix (incl. Shamir-recovered dropped rows) is a replicated host
+    input, the per-pair mask scan runs in every process's lanes, and
+    the int32 cancellation survives the cross-process psum — identical
+    final params on both hosts."""
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(str(tmp_path / "runs"), "pairwise"),
+        timeout=600,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert {p[0] for p in parsed} == {"0", "1"}
+    assert all(p[1] == "6" for p in parsed)
+    assert parsed[0][2:] == parsed[1][2:], parsed
