@@ -464,3 +464,27 @@ def test_hbm_preflight_rejects_gossip_at_scale():
     cfg.run.num_lanes = 8
     cfg.data.num_clients = 1000
     Experiment(cfg, echo=False)  # no raise
+
+
+def test_partial_gossip_composes_with_dropout(tmp_path):
+    """Partial participation + dropout_rate: a dropped COHORT member
+    relays only (decentralized dropout semantics), non-cohort members
+    were never scheduled — the two mechanisms compose without double
+    counting. Pinned by the examples metric: it must equal the sum of
+    the surviving cohort members' real example counts."""
+    cfg = _gossip_cfg(tmp_path, rounds=3, n_clients=16)
+    cfg.server.cohort_size = 8
+    cfg.server.dropout_rate = 0.3
+    exp = Experiment(cfg, echo=False)
+    cohort, idx, mask, n_ex, *_ = exp._host_inputs(0)
+    assert len(cohort) == 8  # the sampled cohort, not all 16
+    # dropped members have zero mask (relay-only) AND zero weight
+    dropped = np.asarray(n_ex) == 0
+    m = np.asarray(jax.device_get(mask))
+    assert (m[dropped] == 0).all()
+    state = exp.fit()
+    assert int(state["round"]) == 3
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree.leaves(state["params"])
+    )
