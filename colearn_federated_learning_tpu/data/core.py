@@ -120,10 +120,17 @@ def _synthetic_template_pair(rng: np.random.Generator, n: int,
     return x_u8, y
 
 
-def _synthetic_text(rng: np.random.Generator, n: int, seq_len: int, vocab: int):
+def _synthetic_text(rng: np.random.Generator, n: int, seq_len: int, vocab: int,
+                    successors: np.ndarray):
     """Sequences from a fixed sparse Markov chain → next-token prediction is
-    learnable well above chance (each symbol has ~4 plausible successors)."""
-    successors = rng.integers(0, vocab, size=(vocab, 4))
+    learnable well above chance (each symbol has ~4 plausible successors).
+
+    ``successors`` is REQUIRED (no convenient default): the caller draws
+    the transition table ONCE and shares it between the train and test
+    calls — drawing it per call (the pre-r5 behavior) gave the two
+    splits DIFFERENT chains, so eval accuracy sat at chance (with
+    worse-than-uniform loss) no matter how well the model learned the
+    train chain."""
     seqs = np.empty((n, seq_len + 1), np.int32)
     state = rng.integers(0, vocab, size=n)
     seqs[:, 0] = state
@@ -317,8 +324,11 @@ def _load_shakespeare(cfg: DataConfig, vocab_size: int = 90, seq_len: int = 80, 
     if not cfg.synthetic_fallback:
         raise FileNotFoundError(f"shakespeare: no data under {data_dir}")
     rng = np.random.default_rng(1207)
-    tx, ty = _synthetic_text(rng, _scaled_train_size(cfg), seq_len, vocab_size)
-    ex, ey = _synthetic_text(rng, cfg.synthetic_test_size, seq_len, vocab_size)
+    successors = rng.integers(0, vocab_size, size=(vocab_size, 4))
+    tx, ty = _synthetic_text(rng, _scaled_train_size(cfg), seq_len, vocab_size,
+                             successors)
+    ex, ey = _synthetic_text(rng, cfg.synthetic_test_size, seq_len, vocab_size,
+                             successors)
     return tx, ty, ex, ey, {"source": "synthetic", "input_shape": (seq_len,)}, vocab_size, "lm"
 
 
