@@ -294,6 +294,53 @@ class DPConfig:
 
 
 @dataclass
+class AttackConfig:
+    """Byzantine adversary simulation (server/attacks.py): a
+    deterministic ``(run.seed)``-pure set of compromised clients
+    attacks during ``fit``, so the robust aggregation stack can be
+    MEASURED against a live adversary instead of hand-crafted tensors.
+
+    Threat model — where each attack acts:
+
+    - upload attacks (``sign_flip``/``gauss``/``scale``/``alie``): the
+      compromised client controls its wire message; the transform
+      applies to its delta after clipping/compression (the honest
+      client's update rule) and before aggregation, inside the round
+      program (a ``[K]`` byzantine-mask input — no retrace, exact
+      sharded↔sequential parity). Under ``algorithm=gossip`` the
+      "upload" is the poisoned replica the attacker gossips to its
+      ring neighbours (``alie`` is rejected there: it sizes itself
+      from cohort statistics a decentralized attacker cannot observe).
+    - ``label_flip``: data poisoning — the compromised clients'
+      training labels are flipped ``y → (C−1)−y`` host-side before
+      corpus placement; the upload is an honest gradient of poisoned
+      data. Composes with any engine path (no engine involvement).
+
+    Expected defense behavior (the headline e2e test pins it): plain
+    ``weighted_mean`` collapses under ``sign_flip`` at f=2/8 while
+    krum / median / trimmed_mean hold their benign accuracy band.
+
+    Pairings rejected by validate() (with reasons): secure_aggregation,
+    client-level DP, example-level DP, scaffold/feddyn, fedbuff,
+    error_feedback; upload attacks additionally reject fuse_rounds>1.
+    """
+
+    # "" (off) | sign_flip | gauss | scale | alie | label_flip
+    kind: str = ""
+    # fraction of the FEDERATION compromised; the id set is
+    # round(fraction·num_clients) clients (≥1), drawn deterministically
+    # from run.seed — identical across engines, resumes, and reruns
+    fraction: float = 0.25
+    # sign_flip/scale boost factor: sign_flip uploads −scale·Δ, scale
+    # uploads +scale·Δ (model-replacement boosting). 1.0 = pure flip /
+    # honest magnitude.
+    scale: float = 10.0
+    # gauss: per-coordinate noise std (the upload is eps·N(0,I));
+    # alie: the z of μ − z·σ (how many honest stds the colluders shift)
+    eps: float = 1.0
+
+
+@dataclass
 class RunConfig:
     seed: int = 0
     # sharded: the shard_map/psum round engine (one XLA program per round)
@@ -394,6 +441,7 @@ class ExperimentConfig:
     client: ClientConfig = field(default_factory=ClientConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
     dp: DPConfig = field(default_factory=DPConfig)
+    attack: AttackConfig = field(default_factory=AttackConfig)
     run: RunConfig = field(default_factory=RunConfig)
 
     def _effective_local_dtype(self) -> str:
@@ -876,6 +924,98 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown dp.clipping {self.dp.clipping!r}"
             )
+        atk = self.attack
+        if atk.kind:
+            from colearn_federated_learning_tpu.server.attacks import (
+                ATTACK_KINDS,
+                UPLOAD_ATTACKS,
+            )
+
+            if atk.kind not in ATTACK_KINDS:
+                raise ValueError(
+                    f"unknown attack.kind {atk.kind!r}; "
+                    f"known: {sorted(ATTACK_KINDS)}"
+                )
+            if not 0.0 < atk.fraction < 1.0:
+                raise ValueError(
+                    f"attack.fraction must be in (0, 1), got {atk.fraction}"
+                )
+            if atk.scale <= 0.0:
+                raise ValueError(
+                    f"attack.scale must be > 0, got {atk.scale}"
+                )
+            if atk.eps < 0.0:
+                raise ValueError(
+                    f"attack.eps must be >= 0, got {atk.eps}"
+                )
+            # pairing rejections (the _check_engine_compat mirror — each
+            # combination is unsound, not merely unimplemented):
+            if self.server.secure_aggregation:
+                raise ValueError(
+                    "attack simulation is incompatible with "
+                    "secure_aggregation: masking hides exactly the "
+                    "per-client uploads the attack transform acts on, "
+                    "and a Byzantine upload breaks the honest-clipping "
+                    "int32 range analysis"
+                )
+            if self.server.dp_client_noise_multiplier > 0.0:
+                raise ValueError(
+                    "attack simulation is incompatible with client-level "
+                    "DP: the sensitivity analysis assumes every upload "
+                    "honors the clip bound — a Byzantine upload voids "
+                    "the reported dp_client_epsilon"
+                )
+            if self.dp.enabled:
+                raise ValueError(
+                    "attack simulation is incompatible with dp.enabled: "
+                    "the example-level accountant assumes every client "
+                    "runs the DP-SGD mechanism, which a Byzantine client "
+                    "does not — the reported dp_epsilon would be "
+                    "misleading"
+                )
+            if self.algorithm in ("scaffold", "feddyn"):
+                raise ValueError(
+                    f"attack simulation is incompatible with "
+                    f"algorithm={self.algorithm!r}: poisoned uploads "
+                    f"enter the persistent c/h state through a plain "
+                    f"mean the robust stack cannot defend (same "
+                    f"reasoning as the robust-aggregator rejection)"
+                )
+            if self.algorithm == "fedbuff":
+                raise ValueError(
+                    "attack simulation is incompatible with "
+                    "algorithm='fedbuff': the async buffer has no "
+                    "per-cohort upload stack to transform, and "
+                    "staleness-decayed weights have no Byzantine "
+                    "semantics"
+                )
+            if self.server.error_feedback:
+                raise ValueError(
+                    "attack simulation is incompatible with "
+                    "error_feedback: a Byzantine client's residual "
+                    "memory is unbounded hidden state carried across "
+                    "rounds"
+                )
+            if atk.kind == "label_flip" and self.model.num_classes < 2:
+                raise ValueError(
+                    "attack.kind='label_flip' requires a classification "
+                    "label space (model.num_classes >= 2)"
+                )
+            if atk.kind in UPLOAD_ATTACKS:
+                if self.run.fuse_rounds > 1:
+                    raise ValueError(
+                        "upload attacks are incompatible with "
+                        "run.fuse_rounds > 1 (the fused scan is the "
+                        "plain-psum path; per-round byzantine masks and "
+                        "delta stacks are per-round inputs)"
+                    )
+                if self.algorithm == "gossip" and atk.kind == "alie":
+                    raise ValueError(
+                        "attack.kind='alie' is incompatible with "
+                        "algorithm='gossip': alie sizes its perturbation "
+                        "from cohort-wide statistics a decentralized "
+                        "attacker cannot observe"
+                    )
         if self.data.synthetic_task not in ("template", "template_pair"):
             raise ValueError(
                 f"unknown data.synthetic_task {self.data.synthetic_task!r}"
@@ -922,6 +1062,7 @@ class ExperimentConfig:
             "client": ClientConfig,
             "server": ServerConfig,
             "dp": DPConfig,
+            "attack": AttackConfig,
             "run": RunConfig,
         }
         return build(cls, d)
@@ -1139,6 +1280,35 @@ def _cifar10_gossip_16() -> ExperimentConfig:
     )
 
 
+def _cifar10_krum_byzantine() -> ExperimentConfig:
+    """Beyond-reference: the adversarial workload — the headline
+    CIFAR-10 federation under a live sign-flipping adversary (attack.*,
+    server/attacks.py) defended by Krum. 2/16 cohort slots are expected
+    Byzantine in steady state (fraction 0.125 of 100 clients ≈ 12
+    compromised, cohort 16 uniform), matching the krum_byzantine=2
+    defense assumption within the Blanchard 2f+2 < n resilience bound.
+    The per-round ``byzantine_count`` metric logs the realized count."""
+    return ExperimentConfig(
+        name="cifar10_krum_byzantine",
+        algorithm="fedavg",
+        model=ModelConfig(name="resnet18", num_classes=10),
+        data=DataConfig(
+            name="cifar10",
+            num_clients=100,
+            partition="dirichlet",
+            dirichlet_alpha=0.5,
+            max_examples_per_client=512,
+        ),
+        client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
+        server=ServerConfig(
+            num_rounds=500, cohort_size=16, eval_every=10,
+            aggregator="krum", krum_byzantine=2,
+        ),
+        attack=AttackConfig(kind="sign_flip", fraction=0.125, scale=10.0),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+    )
+
+
 _NAMED = {
     "mnist_fedavg_2": _mnist_fedavg_2,
     "cifar10_fedavg_100": _cifar10_fedavg_100,
@@ -1147,6 +1317,7 @@ _NAMED = {
     "shakespeare_fedavg": _shakespeare_fedavg,
     "imagenet_silo_dp": _imagenet_silo_dp,
     "cifar10_gossip_16": _cifar10_gossip_16,
+    "cifar10_krum_byzantine": _cifar10_krum_byzantine,
 }
 
 

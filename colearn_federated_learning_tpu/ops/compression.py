@@ -28,8 +28,18 @@ per-tensor compression real systems use). All math f32.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
+
+_log = logging.getLogger(__name__)
+
+# warn exactly once per process when top-k switches to the sampled-
+# quantile approximate threshold (ADVICE r5 #2: the semantics change —
+# selected counts within ±10% of k instead of exact — must be
+# observable, not silent)
+_approx_warned = False
 
 
 # Coordinate-subsample size for the estimated top-k threshold. The
@@ -86,6 +96,19 @@ def make_compressor(kind: str, topk_ratio: float = 0.01, qsgd_levels: int = 256,
                     # small-model test oracles bitwise
                     thresh = -jnp.sort(-mag, axis=1)[:, k - 1 : k]
                 else:
+                    global _approx_warned
+                    if not _approx_warned:
+                        _approx_warned = True
+                        _log.warning(
+                            "topk compression: leaf with %d coords >= %d "
+                            "uses the sampled-quantile APPROXIMATE "
+                            "threshold (selected count within ~±10%% of "
+                            "k, worse if |delta| has stride-aligned "
+                            "periodic structure); set "
+                            "server.compression_topk_exact=true for the "
+                            "exact full-sort threshold",
+                            n, 2 * _TOPK_SAMPLE,
+                        )
                     # estimated threshold: the (m·k/n)-th largest of a
                     # STRIDED coordinate sample. Strided (not random-
                     # gather) is a measured choice: a 65k random gather

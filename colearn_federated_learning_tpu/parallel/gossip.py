@@ -66,7 +66,9 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
                          num_clients: int, gamma: float = 1.0 / 3.0,
                          mixing_steps: int = 1, topology: str = "ring",
                          donate: bool = True, local_dtype=None,
-                         scan_unroll: int = 1, cohort_size: int = 0):
+                         scan_unroll: int = 1, cohort_size: int = 0,
+                         attack: str = "", attack_scale: float = 10.0,
+                         attack_eps: float = 1.0):
     """Build the jitted one-program gossip round.
 
     Signature of the returned fn (full participation,
@@ -97,9 +99,32 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
 
     ``num_clients`` must divide evenly over the mesh's client lanes
     (there are no pad rows to hide); so must ``cohort_size``.
+
+    ``attack`` (server/attacks.py): the decentralized threat model — a
+    compromised client gossips a POISONED replica to its neighbours.
+    The round fn gains a trailing ``byz`` mask input (``[N]`` under
+    full participation, ``[K]`` under partial — aligned with ``n_ex``);
+    after local training and before mixing, each compromised client's
+    local update ``x_trained − x_pre`` is transformed by the shared
+    per-client attack operator (``sign_flip``/``gauss``/``scale``;
+    ``alie`` is rejected — it sizes itself from cohort statistics a
+    decentralized attacker cannot observe) and its replica rewritten to
+    ``x_pre + Δ_attacked``. Honest neighbours then mix the poison in.
     """
     if topology not in ("ring", "full"):
         raise ValueError(f"unknown gossip topology {topology!r}")
+    if attack:
+        from colearn_federated_learning_tpu.server.attacks import (
+            UPLOAD_ATTACKS,
+        )
+
+        if attack not in UPLOAD_ATTACKS:
+            raise ValueError(f"unknown upload attack {attack!r}")
+        if attack == "alie":
+            raise ValueError(
+                "attack='alie' is incompatible with gossip (no cohort "
+                "statistics are observable to a decentralized attacker)"
+            )
     if client_cfg.lr_decay != 1.0:
         # mirror config.validate(): no lr_scale is plumbed into
         # local_train here, so decay would be silently dropped for a
@@ -143,8 +168,33 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
     fwd = [(i, (i + 1) % n_lanes) for i in range(n_lanes)]
     bwd = [(i, (i - 1) % n_lanes) for i in range(n_lanes)]
 
-    def lane_fn(replicas, train_x, train_y, idx, mask, n_ex, keys,
-                cohort_ids=None):
+    if attack:
+        from colearn_federated_learning_tpu.server.attacks import (
+            apply_upload_attack,
+        )
+
+    def _poison(trained_t, pre_t, byz_b, keys_b):
+        """Rewrite the compromised rows' replicas to ``x_pre +
+        attack(Δ)`` where ``Δ = x_trained − x_pre`` — the shared
+        per-client upload transform applied at the decentralized
+        "upload": the replica about to be gossiped. f32 math, cast back
+        to the replica storage dtype."""
+        delta = jax.tree.map(
+            lambda t, p: t.astype(jnp.float32) - p.astype(jnp.float32),
+            trained_t, pre_t,
+        )
+        delta = apply_upload_attack(
+            delta, byz_b, keys_b, attack, attack_scale, attack_eps
+        )
+        return jax.tree.map(
+            lambda p, d: (p.astype(jnp.float32) + d).astype(p.dtype),
+            pre_t, delta,
+        )
+
+    def lane_fn(replicas, train_x, train_y, idx, mask, n_ex, keys, *rest):
+        rest = list(rest)
+        cohort_ids = rest.pop(0) if cohort_size else None
+        byz = rest.pop(0) if attack else None
         # --- local phase ----------------------------------------------
         def per_row(_, inp):
             r_params, r_idx, r_mask, r_key = inp
@@ -180,6 +230,10 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             _, (trained_chunk, losses) = jax.lax.scan(
                 per_row, 0.0, (chunk, idx, mask, keys)
             )
+            if attack:
+                # poison the cohort's uploads before the scatter — the
+                # byz mask is cohort-aligned ([K], sharded like n_ex)
+                trained_chunk = _poison(trained_chunk, chunk, byz, keys)
             trained_full = jax.tree.map(
                 lambda t: jax.lax.all_gather(
                     t, CLIENT_AXIS, axis=0, tiled=True
@@ -195,6 +249,9 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             _, (trained, losses) = jax.lax.scan(
                 per_row, 0.0, (replicas, idx, mask, keys)
             )
+            if attack:
+                # byz mask is [N], sharded — this lane poisons its rows
+                trained = _poison(trained, replicas, byz, keys)
 
         # --- gossip phase: mixing_steps sweeps of W -------------------
         def sweep_ring(tree):
@@ -270,6 +327,8 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
                 P(CLIENT_AXIS), P(CLIENT_AXIS))
     if cohort_size:
         in_specs += (P(),)  # cohort ids, replicated
+    if attack:
+        in_specs += (P(CLIENT_AXIS),)  # byz mask, aligned with n_ex
     sharded_lane = jax.shard_map(
         lane_fn,
         mesh=mesh,
@@ -280,7 +339,7 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def round_fn(replicas, train_x, train_y, idx, mask, n_ex, rng,
-                 cohort_ids=None):
+                 cohort_ids=None, byz=None):
         for leaf in jax.tree.leaves(replicas):
             if leaf.shape[0] != num_clients:
                 raise ValueError(
@@ -294,6 +353,10 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             if cohort_ids is None:
                 raise TypeError("partial gossip requires cohort_ids")
             extra = (cohort_ids,)
+        if attack:
+            if byz is None:
+                raise TypeError(f"attack={attack!r} requires the byz mask input")
+            extra += (byz,)
         mixed, mean_params, out = sharded_lane(
             replicas, train_x, train_y, idx, mask, n_ex, keys, *extra
         )

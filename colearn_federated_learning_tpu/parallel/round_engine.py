@@ -99,7 +99,7 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=False, feddyn=False, client_dp=0.0,
                          downlink="", secagg_quant_step=0.0,
-                         error_feedback=False):
+                         error_feedback=False, attack=""):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -195,6 +195,41 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
             raise ValueError(
                 "error_feedback breaks the per-round upload norm bound "
                 "secure aggregation / client-level DP require"
+            )
+    if attack:
+        # mirror config.validate()'s attack pairing rejections so a
+        # direct engine caller can't build an unsound adversary
+        # simulation (see AttackConfig)
+        from colearn_federated_learning_tpu.server.attacks import (
+            UPLOAD_ATTACKS,
+        )
+
+        if attack not in UPLOAD_ATTACKS:
+            raise ValueError(
+                f"unknown upload attack {attack!r} "
+                f"(label_flip is host-side and never reaches the engine)"
+            )
+        if secagg:
+            raise ValueError(
+                "attack simulation is incompatible with secure "
+                "aggregation (masking hides the uploads the attack "
+                "transform acts on)"
+            )
+        if client_dp > 0.0:
+            raise ValueError(
+                "attack simulation is incompatible with client-level DP "
+                "(a Byzantine upload voids the sensitivity analysis)"
+            )
+        if scaffold or feddyn:
+            raise ValueError(
+                "attack simulation is incompatible with stateful "
+                "algorithms (poisoned uploads enter the persistent c/h "
+                "state through an undefendable plain mean)"
+            )
+        if error_feedback:
+            raise ValueError(
+                "attack simulation is incompatible with error_feedback "
+                "(a Byzantine residual memory is unbounded hidden state)"
             )
 
 
@@ -461,7 +496,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           downlink: str = "",
                           downlink_levels: int = 256,
                           error_feedback: bool = False,
-                          fuse_rounds: int = 1):
+                          fuse_rounds: int = 1,
+                          attack: str = "",
+                          attack_scale: float = 10.0,
+                          attack_eps: float = 1.0):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -556,12 +594,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     applies ``h ← h + ΣΔgᵢ/N;  w ← w₀ + Δ̄ − h/α`` (c_global carries h;
     the server optimizer is bypassed — FedDyn defines its own update —
     but the round counter still advances for LR decay).
+
+    ``attack`` (server/attacks.py): Byzantine adversary simulation. The
+    round fn gains an optional trailing ``byz`` input — a ``[K]`` 0/1
+    mask of compromised cohort slots, an ARRAY input alongside ``n_ex``
+    so the attacked-set can change per round with no retrace. On
+    attacked rounds the lane emits the per-client delta stack (the
+    robust aggregators' path — order statistics need it anyway, and
+    ``alie`` needs cohort statistics), the attack transform applies to
+    the stack after clipping/compression and before aggregation —
+    exactly where a real attacker controls the upload — and the
+    aggregate is the weighted mean over the (poisoned) stack or
+    ``robust_reduce`` under a robust ``aggregator``. The transform and
+    the stack aggregation are one shared implementation with the
+    sequential oracle, so attacked-round parity holds by construction.
     """
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
                          secagg_quant_step=secagg_quant_step,
-                         error_feedback=error_feedback)
+                         error_feedback=error_feedback, attack=attack)
     if client_dp_noise > 0.0 and agg != "uniform":
         # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
         raise ValueError(
@@ -617,6 +669,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if aggregator not in ("weighted_mean", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown aggregator {aggregator!r}")
     robust = aggregator != "weighted_mean"
+    # attacked rounds need the per-client delta stack (the transform —
+    # and alie's cohort statistics — act on individual uploads), so the
+    # lane emits it exactly as the robust aggregators do
+    emit_stack = robust or bool(attack)
+    if attack and fuse_rounds > 1:
+        raise ValueError(
+            "attack simulation is incompatible with fuse_rounds > 1 "
+            "(per-round byzantine masks / delta stacks are per-round "
+            "inputs)"
+        )
     use_decay = client_cfg.lr_decay != 1.0
     from colearn_federated_learning_tpu.ops.compression import (
         downlink_quantize,
@@ -633,6 +695,24 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         return downlink_quantize(
             params, jax.random.fold_in(rng, _DOWNLINK_FOLD), downlink_levels
         )
+
+    def _cohort_keys(rng, n):
+        """Per-client round keys, pinned REPLICATED before they enter the
+        shard_map. On the 2-axis clients×batch mesh, pre-0.6 jax's GSPMD
+        partitioner can mis-partition the threefry computation feeding
+        the manual region (observed on jax 0.4.37 CPU: every key word
+        arrives summed over the batch axis — per-client DP noise then
+        diverges between the 1D and 2D meshes); the explicit replicated
+        constraint forces the partitioner to materialize the true
+        values. No-op placement-wise on 1D meshes and vma-aware jax."""
+        keys = jax.random.split(rng, n)
+        if batch_sharded:
+            from jax.sharding import NamedSharding
+
+            keys = jax.lax.with_sharding_constraint(
+                keys, NamedSharding(mesh, P())
+            )
+        return keys
 
     def lane_fn(params, train_x, train_y, idx, mask, n_ex, keys, *rest):
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
@@ -775,9 +855,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 delta_b = comp_b
             elif compress is not None:
                 delta_b = compress(delta_b, b_keys)
-            if robust:
-                # robust modes need every client's delta individually —
-                # emit the block's deltas instead of accumulating
+            if emit_stack:
+                # robust/attacked modes need every client's delta
+                # individually — emit the block's deltas instead of
+                # accumulating
                 ys["delta"] = delta_b
             elif secagg:
                 # survivor uploads + server mask reconstruction for
@@ -846,10 +927,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             if stateful else jnp.zeros(())
         )
-        # robust modes emit per-client deltas as scan ys instead of the
-        # weighted-sum accumulator — collapse that carry slot to a scalar;
-        # secagg accumulates the masked fixed-point uploads in int32
-        if robust:
+        # robust/attacked modes emit per-client deltas as scan ys instead
+        # of the weighted-sum accumulator — collapse that carry slot to a
+        # scalar; secagg accumulates the masked fixed-point uploads in int32
+        if emit_stack:
             d0 = jnp.zeros(())
         elif secagg:
             d0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.int32), params)
@@ -886,7 +967,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             jnp.float32(dp_fixed_denom or cohort_size)
             if client_dp_noise > 0.0 else denom
         )
-        if robust:
+        if emit_stack:
             out["deltas"] = unblock(ys["delta"])  # client-sharded stack
         else:
             d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
@@ -956,7 +1037,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if client_dp_noise > 0.0:
         in_specs += (P(),)  # central DP noise key, replicated
     out_specs = {"n": P(), "loss": P()}
-    if robust:
+    if emit_stack:
         out_specs["deltas"] = P(CLIENT_AXIS)
     else:
         out_specs["mean_delta"] = P()
@@ -971,16 +1052,39 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         out_specs=out_specs,
     )
 
-    def _mean_delta(out, n_ex):
-        if robust:
-            from colearn_federated_learning_tpu.server.aggregation import (
-                robust_reduce,
+    def _mean_delta(out, n_ex, params=None, byz=None, keys=None):
+        if emit_stack:
+            deltas = out["deltas"]
+            if attack:
+                from colearn_federated_learning_tpu.server.attacks import (
+                    apply_upload_attack,
+                )
+
+                # the attack transform acts on the global [K, ...]
+                # stack under the same jit (plain jnp — GSPMD handles
+                # the client-sharded axis), after clipping/compression
+                # and before aggregation: the upload boundary
+                deltas = apply_upload_attack(
+                    deltas, byz, keys, attack, attack_scale, attack_eps,
+                    participation=n_ex > 0,
+                )
+            if robust:
+                from colearn_federated_learning_tpu.server.aggregation import (
+                    robust_reduce,
+                )
+
+                # the coordinate-wise sort runs as plain jnp under jit —
+                # GSPMD handles the lanes
+                return robust_reduce(deltas, n_ex > 0, aggregator,
+                                     trim_ratio, byzantine_f)
+            from colearn_federated_learning_tpu.server.attacks import (
+                stack_weighted_mean,
             )
 
-            # global [K, ...] stack, client-sharded; the coordinate-wise
-            # sort runs as plain jnp under jit — GSPMD handles the lanes
-            return robust_reduce(out["deltas"], n_ex > 0, aggregator,
-                                 trim_ratio, byzantine_f)
+            # weighted_mean over the (attacked) stack — the stacked-path
+            # twin of the in-lane psum accumulation, shared with the
+            # sequential oracle
+            return stack_weighted_mean(deltas, n_ex, agg, params)
         return out["mean_delta"]
 
     if stateful:
@@ -997,7 +1101,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         f"store; pad rows are never addressed)"
                     )
                 break
-            keys = jax.random.split(rng, idx.shape[0])
+            keys = _cohort_keys(rng, idx.shape[0])
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
@@ -1044,7 +1148,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         f"store; pad rows are never addressed)"
                     )
                 break
-            keys = jax.random.split(rng, idx.shape[0])
+            keys = _cohort_keys(rng, idx.shape[0])
             extra = ()
             if use_decay:
                 extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
@@ -1065,7 +1169,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
                      n_ex, rng, pair_seeds=None):
-            keys = jax.random.split(rng, idx.shape[0])
+            keys = _cohort_keys(rng, idx.shape[0])
             if secagg_mode == "pairwise":
                 # pairwise mode: the seed matrix is a host-built INPUT
                 # (key agreement + Shamir recovery are host protocol
@@ -1097,8 +1201,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         return round_fn
 
     def _one_round(params, server_opt_state, train_x, train_y, idx, mask,
-                   n_ex, rng):
-        keys = jax.random.split(rng, idx.shape[0])
+                   n_ex, rng, byz=None):
+        if attack and byz is None:
+            raise TypeError(f"attack={attack!r} requires the byz mask input")
+        keys = _cohort_keys(rng, idx.shape[0])
         extra = ()
         if use_decay:
             # round-indexed client LR decay, derived inside the program
@@ -1113,7 +1219,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             *extra, *tail,
         )
         new_params, new_opt_state = server_update(
-            params, server_opt_state, _mean_delta(out, n_ex)
+            params, server_opt_state, _mean_delta(out, n_ex, params, byz, keys)
         )
         return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
 
@@ -1320,7 +1426,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              dp_fixed_denom: float = 0.0,
                              downlink: str = "",
                              downlink_levels: int = 256,
-                             error_feedback: bool = False):
+                             error_feedback: bool = False,
+                             attack: str = "",
+                             attack_scale: float = 10.0,
+                             attack_eps: float = 1.0):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -1335,7 +1444,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
                          secagg_quant_step=secagg_quant_step,
-                         error_feedback=error_feedback)
+                         error_feedback=error_feedback, attack=attack)
     if client_dp_noise > 0.0 and agg != "uniform":
         raise ValueError(
             "client-level DP requires uniform aggregation weights "
@@ -1374,7 +1483,9 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     )
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
-                 c_global=None, c_cohort=None, pair_seeds=None):
+                 c_global=None, c_cohort=None, pair_seeds=None, byz=None):
+        if attack and byz is None:
+            raise TypeError(f"attack={attack!r} requires the byz mask input")
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
         lr_scale = (
@@ -1511,16 +1622,38 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             jnp.float32(dp_fixed_denom or k)
             if client_dp_noise > 0.0 else denom
         )
-        if robust:
-            from colearn_federated_learning_tpu.server.aggregation import (
-                robust_reduce,
-            )
-
+        if robust or attack:
+            # the per-client stack path — identical ops to the sharded
+            # engine's _mean_delta (shared transform + shared stack
+            # aggregation), so attacked/robust rounds agree across
+            # engines by construction
             stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *deltas)
-            mean_delta = robust_reduce(
-                stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio,
-                byzantine_f,
-            )
+            if attack:
+                from colearn_federated_learning_tpu.server.attacks import (
+                    apply_upload_attack,
+                )
+
+                stacked = apply_upload_attack(
+                    stacked, jnp.asarray(byz), keys, attack, attack_scale,
+                    attack_eps, participation=jnp.asarray(n_ex) > 0,
+                )
+            if robust:
+                from colearn_federated_learning_tpu.server.aggregation import (
+                    robust_reduce,
+                )
+
+                mean_delta = robust_reduce(
+                    stacked, jnp.asarray(n_ex) > 0, aggregator, trim_ratio,
+                    byzantine_f,
+                )
+            else:
+                from colearn_federated_learning_tpu.server.attacks import (
+                    stack_weighted_mean,
+                )
+
+                mean_delta = stack_weighted_mean(
+                    stacked, jnp.asarray(n_ex), agg, params
+                )
         elif secagg:
             # the cohort sum completed the ring: masks cancelled exactly
             mean_delta = jax.tree.map(

@@ -37,6 +37,11 @@ from colearn_federated_learning_tpu.parallel.round_engine import (
     make_sharded_round_fn,
 )
 from colearn_federated_learning_tpu.server.aggregation import make_server_update_fn
+from colearn_federated_learning_tpu.server.attacks import (
+    UPLOAD_ATTACKS,
+    flip_labels,
+    select_compromised,
+)
 from colearn_federated_learning_tpu.server.sampler import CohortSampler
 from colearn_federated_learning_tpu.utils.checkpoint import CheckpointStore
 from colearn_federated_learning_tpu.utils.metrics import MetricsLogger
@@ -145,6 +150,32 @@ class Experiment:
                 1 + (ranks * s) // max(len(work), 1)
             ).astype(np.int32)
         self._async_stats: Dict[int, float] = {}
+        # Byzantine adversary simulation (AttackConfig, server/attacks.py):
+        # the compromised id set is a deterministic pure function of
+        # (run.seed, num_clients, fraction) — fixed for the whole run,
+        # identical across engines and resumes. Upload attacks ride the
+        # engines' [K] byzantine-mask input; label_flip poisons the
+        # compromised clients' training labels host-side below, before
+        # the corpus is placed (so hbm, stream, and both engines all see
+        # the same poisoned shards).
+        self.attack_kind = cfg.attack.kind
+        self._attack_upload = self.attack_kind in UPLOAD_ATTACKS
+        self.compromised = np.zeros(0, np.int64)
+        self._attack_stats: Dict[int, int] = {}
+        if self.attack_kind:
+            self.compromised = select_compromised(
+                self.fed.num_clients, cfg.attack.fraction, cfg.run.seed
+            )
+            if self.attack_kind == "label_flip":
+                if self.fed.task != "classify":
+                    raise ValueError(
+                        "attack.kind='label_flip' requires a "
+                        "classification task"
+                    )
+                self.fed.train_y = flip_labels(
+                    self.fed.train_y, self.fed.client_indices,
+                    self.compromised, self.fed.num_classes,
+                )
         # Size-proportional sampling pairs with UNIFORM aggregation
         # weights: example-weighting on top of p∝size sampling would count
         # shard size twice (contribution ∝ size²). Uniform sampling keeps
@@ -205,6 +236,9 @@ class Experiment:
                     local_dtype=self._local_dtype(),
                     scan_unroll=cfg.run.scan_unroll,
                     cohort_size=cfg.server.cohort_size,
+                    attack=self.attack_kind if self._attack_upload else "",
+                    attack_scale=cfg.attack.scale,
+                    attack_eps=cfg.attack.eps,
                 )
             elif self.fedbuff:
                 self.round_fn = make_async_round_fn(
@@ -245,6 +279,9 @@ class Experiment:
                     downlink_levels=cfg.server.downlink_qsgd_levels,
                     error_feedback=self.ef,
                     fuse_rounds=cfg.run.fuse_rounds,
+                    attack=self.attack_kind if self._attack_upload else "",
+                    attack_scale=cfg.attack.scale,
+                    attack_eps=cfg.attack.eps,
                 )
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -279,6 +316,9 @@ class Experiment:
                 downlink=cfg.server.downlink_compression,
                 downlink_levels=cfg.server.downlink_qsgd_levels,
                 error_feedback=self.ef,
+                attack=self.attack_kind if self._attack_upload else "",
+                attack_scale=cfg.attack.scale,
+                attack_eps=cfg.attack.eps,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -975,11 +1015,15 @@ class Experiment:
                                            survivors.tolist())
             for d, row in rows.items():
                 # DH symmetry guarantees the recovered row equals the
-                # client's own; assert it (cheap, and it IS the protocol
-                # correctness property)
-                assert np.array_equal(row, seeds[d]), (
-                    "Shamir-recovered seeds diverge from DH agreement"
-                )
+                # client's own; check it explicitly (cheap, and it IS
+                # the protocol correctness property — an explicit raise,
+                # not an assert, so the gate survives `python -O`)
+                if not np.array_equal(row, seeds[d]):
+                    raise RuntimeError(
+                        f"pairwise secagg: Shamir-recovered seeds for "
+                        f"dropped client {d} diverge from DH agreement "
+                        f"— seed recovery is corrupt; aborting the round"
+                    )
                 seeds[d] = row
         arr = jnp.asarray(seeds)
         if self._data_sharding is not None:
@@ -992,6 +1036,20 @@ class Experiment:
         (cohort, idx, mask, n_ex, train_x, train_y,
          n_host) = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
+        # Byzantine mask for this round's cohort: which sampled slots
+        # the adversary owns. An ARRAY input alongside n_ex (no
+        # retrace); poisson pad slots (id == num_clients) can never be
+        # compromised. byzantine_count is recorded for every attack
+        # kind (label_flip included — its slots attack through data).
+        akw = {}
+        if self.attack_kind:
+            byz_h = np.isin(np.asarray(cohort), self.compromised)
+            self._attack_stats[round_idx] = int(byz_h.sum())
+            if self._attack_upload:
+                byz = jnp.asarray(byz_h.astype(np.float32))
+                if self._client_sharding is not None:
+                    byz = self._put(byz, self._client_sharding)
+                akw["byz"] = byz
         if self.gossip:
             extra = ()
             if self._gossip_partial:
@@ -1001,7 +1059,7 @@ class Experiment:
                 ),)
             replicas, mean_params, metrics = self.round_fn(
                 state["replicas"], train_x, train_y, idx, mask, n_ex, rng,
-                *extra,
+                *extra, **akw,
             )
             return {
                 "params": mean_params,
@@ -1075,11 +1133,17 @@ class Experiment:
             chunks = [(idx, mask, n_ex)]
             rngs = [rng]
             for j in range(1, fuse):
-                (_, i_j, m_j, n_j, tx_j, ty_j,
+                (c_j, i_j, m_j, n_j, tx_j, ty_j,
                  _) = self._round_inputs(round_idx + j)
                 chunks.append((i_j, m_j, n_j))
                 rngs.append(jax.random.fold_in(state["rng_key"],
                                                round_idx + j))
+                if self.attack_kind:
+                    # label_flip composes with fusion (data-level only);
+                    # keep byzantine_count per fused sub-round
+                    self._attack_stats[round_idx + j] = int(
+                        np.isin(np.asarray(c_j), self.compromised).sum()
+                    )
             stack = lambda xs: jnp.stack([jnp.asarray(x) for x in xs])  # noqa: E731
             params, opt_state, metrics = self.round_fn(
                 state["params"], state["server_opt_state"], train_x,
@@ -1094,7 +1158,7 @@ class Experiment:
                 "rng_key": state["rng_key"],
                 "_metrics": metrics,
             }
-        kw = {}
+        kw = dict(akw)
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
             kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
         params, opt_state, metrics = self.round_fn(
@@ -1287,6 +1351,35 @@ class Experiment:
                 # aborting mechanism (see dp_client_epsilon)
                 "dp_delta_abort": float(self.dp_delta_abort()),
             })
+        if start_round == 0 and self.attack_kind:
+            # attack provenance: everything needed to attribute a run's
+            # metrics to its adversary (kind, knobs, the compromised set)
+            self.logger.log({
+                "event": "attack",
+                "kind": self.attack_kind,
+                "fraction": cfg.attack.fraction,
+                "scale": cfg.attack.scale,
+                "eps": cfg.attack.eps,
+                "n_compromised": int(len(self.compromised)),
+                "compromised": [int(c) for c in self.compromised[:64]],
+            })
+        if start_round == 0 and cfg.dp.enabled and cfg.dp.clipping == "two_pass":
+            # ADVICE r5 #1: two_pass clipping is exact only up to
+            # floating-point reassociation between the pass-1 norms and
+            # the pass-2 released gradients; the accountant does not
+            # model that slack, so make the assumption visible in the
+            # run log next to the epsilons it qualifies
+            self.logger.log({
+                "event": "warning",
+                "warning": "dp_two_pass_clipping",
+                "detail": (
+                    "dp.clipping='two_pass' with DP accounting enabled: "
+                    "the reported dp_epsilon assumes exact per-example "
+                    "clipping; two_pass clipping is exact only up to "
+                    "floating-point reassociation between the norm pass "
+                    "and the release pass"
+                ),
+            })
         if start_round == 0 and self.fed.meta.get("repair_used"):
             # the Dirichlet extreme-α repair changed the realized label
             # skew — record it in the run log so experiments at extreme α
@@ -1341,6 +1434,11 @@ class Experiment:
                     record["mean_staleness"] = round(
                         self._async_stats.pop(ridx), 3
                     )
+                if ridx in self._attack_stats:
+                    # compromised clients sampled into this round's
+                    # cohort (attack provenance: the "attack" event at
+                    # fit start records kind/knobs/the full set)
+                    record["byzantine_count"] = self._attack_stats.pop(ridx)
                 if hasattr(m, "consensus_dist"):
                     # decentralized health: Σ‖xᵢ−x̄‖²/N after mixing
                     record["consensus_dist"] = float(m.consensus_dist)

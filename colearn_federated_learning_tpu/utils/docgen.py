@@ -19,9 +19,48 @@ _SECTIONS = [
     ("server", config_mod.ServerConfig,
      "Round schedule, aggregation, algorithms' server-side knobs."),
     ("dp", config_mod.DPConfig, "DP-SGD (per-example clip + noise, RDP accounting)."),
+    ("attack", config_mod.AttackConfig,
+     "Byzantine adversary simulation (in-loop attack injection)."),
     ("run", config_mod.RunConfig,
      "Engine/mesh/dtype/ops switches (profiling, retries, host pipeline)."),
 ]
+
+# appended under the `attack` section table (kept here so the generated
+# doc and the committed doc cannot drift apart)
+_THREAT_MODEL = """\
+### Threat model
+
+Where each attack acts, and which defenses are expected to hold:
+
+| attack | acts on | mechanism |
+|---|---|---|
+| `sign_flip` | upload | compromised delta becomes `-scale*delta` (gradient reversal, boosted) |
+| `gauss` | upload | compromised delta replaced by `eps*N(0, I)` (pure noise) |
+| `scale` | upload | compromised delta becomes `scale*delta` (model-replacement boosting) |
+| `alie` | upload | all colluders send `mean - eps*std` of the honest cohort's per-coordinate statistics ("a little is enough", Baruch et al. 2019) |
+| `label_flip` | data | compromised clients' training labels flipped `y -> (C-1)-y` host-side; the upload is an honest gradient of poisoned data |
+
+Upload attacks apply inside the round program, after clipping/compression
+(the honest client's update rule) and before aggregation — the point a
+real attacker controls. The compromised id set is a deterministic pure
+function of `run.seed`; a `[K]` byzantine-mask input rides alongside
+`n_ex`, so the attacked set changes per round with no retrace and the
+sharded and sequential engines agree on attacked rounds. Under
+`algorithm=gossip` the poisoned artifact is the replica gossiped to ring
+neighbours (`alie` is rejected there — no cohort statistics are
+observable to a decentralized attacker).
+
+Expected defense behavior (pinned by `tests/test_attack.py`): plain
+`server.aggregator="weighted_mean"` collapses toward chance accuracy
+under `sign_flip` at f=2 of cohort 8, while `krum`, `median`, and
+`trimmed_mean` under the identical attack stay within their benign
+accuracy band. Defenses act per round on the upload stack, so they do
+NOT defend `label_flip` (an honest-looking gradient of poisoned data) —
+that is the attack's point. Unsound pairings (secure aggregation,
+client-level or example-level DP, scaffold/feddyn, fedbuff,
+error feedback, fused rounds under upload attacks) are rejected by
+`validate()` with reasons.
+"""
 
 
 def _fmt(v) -> str:
@@ -63,6 +102,8 @@ def config_reference_markdown() -> str:
                 default = f.default_factory()
             lines.append(f"| `{f.name}` | {_fmt(default)} |")
         lines.append("")
+        if section == "attack":
+            lines += [_THREAT_MODEL]
     names = config_mod.list_named_configs()
     named = ", ".join(f"`{n}`" for n in names)
     lines += [

@@ -36,6 +36,15 @@ _SHRINK = {
     # gossip: the blanket cohort shrink (min(cohort,4)) must keep
     # cohort == num_clients, so shrink the federation to 4 as well
     "cifar10_gossip_16": {"data.num_clients": 4, "model.kwargs.width": 16},
+    # adversarial config: keeps the live sign_flip attack + the krum
+    # path; krum_byzantine must drop to 0 under the blanket cohort
+    # shrink (Blanchard bound 2f+2 < 4), which still exercises the
+    # attacked krum selection
+    "cifar10_krum_byzantine": {
+        "data.num_clients": 16,
+        "model.kwargs.width": 16,
+        "server.krum_byzantine": 0,
+    },
     "imagenet_silo_dp": {
         "data.num_clients": 8,
         "server.cohort_size": 8,

@@ -151,7 +151,9 @@ def _feddyn_cfg(tmp_path, rounds=4):
 def test_feddyn_e2e_h_mean_invariant(tmp_path):
     """h and gᵢ accumulate the same Δg stream, so h == mean(gᵢ) exactly
     (both start 0) — partial participation included."""
-    cfg = _feddyn_cfg(tmp_path, rounds=4)
+    # 6 rounds: 4 left the accuracy sitting ON the 0.5 threshold (an XLA
+    # version bump flipped it to 0.44); 6 clears it with real margin
+    cfg = _feddyn_cfg(tmp_path, rounds=6)
     exp = Experiment(cfg, echo=False)
     state = exp.fit()
     assert exp.feddyn and exp.stateful

@@ -5,7 +5,12 @@ input space the example-based tests sample pointwise."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from colearn_federated_learning_tpu.data.partition import (
     dirichlet_partition,
