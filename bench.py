@@ -263,6 +263,9 @@ def bench_config(name: str):
             m.train_loss if fuse == 1 else m.train_loss[-1]
         )
 
+    # reset the phase-span aggregates so the breakdown below covers the
+    # TIMED region only (the warmup window holds the compiles)
+    exp.tracer.drain()
     t0 = time.perf_counter()
     pending = []
     for r in range(warmup, warmup + timed, fuse):
@@ -288,6 +291,13 @@ def bench_config(name: str):
             100.0 * flops_per_round * rounds_per_sec
             / (PEAK_BF16_FLOPS * exp.n_chips)
         )
+    # per-phase host-side timing of the timed region (obs/spans.py):
+    # localizes a wall-clock regression to host inputs / placement /
+    # dispatch (or a mid-bench retrace) without a profiler rerun —
+    # drained BEFORE the device-time pass dispatches extra rounds
+    phase_ms = {
+        k: v["total_ms"] for k, v in exp.tracer.drain().items()
+    }
     # device-time pass for gating (skipped where wall r/s already gates)
     device_ms = None
     if name in DEVICE_MS_BASELINES and (
@@ -297,6 +307,7 @@ def bench_config(name: str):
     vs, vs_basis = _gate(name, rounds_per_sec, device_ms, flops_pct)
     extra = {
         "vs_baseline_basis": vs_basis,
+        "phase_ms": phase_ms,
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
         "n_chips": exp.n_chips,
         "timed_rounds": timed,

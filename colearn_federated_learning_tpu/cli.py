@@ -6,6 +6,7 @@ Entry points with capability parity to the reference's
     colearn fit --config cifar10_fedavg_100 --set server.num_rounds=50
     colearn evaluate --config cifar10_fedavg_100
     colearn configs            # list the named BASELINE configs
+    colearn summarize <run>    # per-phase timing table from a run's JSONL
 
 ``--config`` accepts a registry name or a YAML path; ``--set a.b=v``
 overrides any field. ``fit --resume`` continues from the latest
@@ -91,6 +92,20 @@ def build_parser():
                     help="output .msgpack path")
 
     sub.add_parser("configs", help="list named configs")
+
+    sm = sub.add_parser(
+        "summarize",
+        help="aggregate a run's metrics JSONL into a per-phase "
+             "timing/throughput table (no backend needed)",
+    )
+    sm.add_argument("run", metavar="RUN",
+                    help="run name (looked up under --out-dir), a run "
+                         "directory, or a .metrics.jsonl path")
+    sm.add_argument("--out-dir", default="runs",
+                    help="where <RUN>.metrics.jsonl lives (default: runs)")
+    sm.add_argument("--json", action="store_true",
+                    help="emit the aggregated summary as one JSON object "
+                         "instead of the table")
     return p
 
 
@@ -103,6 +118,23 @@ def main(argv=None):
     if args.cmd == "configs":
         for name in list_named_configs():
             print(name)
+        return 0
+
+    if args.cmd == "summarize":
+        # pure-host JSONL aggregation — runs before (and without) any
+        # jax backend initialization
+        from colearn_federated_learning_tpu.obs import summary as obs_summary
+
+        try:
+            path = obs_summary.resolve_metrics_path(args.run, args.out_dir)
+        except FileNotFoundError as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        agg = obs_summary.summarize_records(obs_summary.load_records(path))
+        if args.json:
+            print(json.dumps(dict(agg, path=path)))
+        else:
+            print(obs_summary.format_summary(agg, path))
         return 0
 
     # multi-host bring-up must precede any backend touch (SURVEY.md §3.5);
@@ -142,7 +174,15 @@ def main(argv=None):
         print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
         return 2
     if args.cmd == "fit":
-        state = exp.fit()
+        from colearn_federated_learning_tpu.obs import HealthAbortError
+
+        try:
+            state = exp.fit()
+        except HealthAbortError as e:
+            # the run's health monitor aborted it (run.obs.on_unhealthy);
+            # the JSONL holds the structured health events — point there
+            print(f"error: run aborted unhealthy: {e}", file=sys.stderr)
+            return 3
         final = {"event": "done", "rounds": int(state["round"]),
                  "wall_time_sec": round(state.get("wall_time", 0.0), 2)}
         final.update(exp.evaluate(state["params"]))

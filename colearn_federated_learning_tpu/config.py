@@ -341,6 +341,46 @@ class AttackConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Round-lifecycle telemetry (``obs/``): phase spans, comm/device
+    counters, and run-health monitoring — the observability layer every
+    perf PR measures against. All host-side; the engines are unchanged
+    apart from trace annotations."""
+
+    # Time the round lifecycle (host inputs → placement → dispatch →
+    # fetch → eval → checkpoint) and log a per-phase `spans` record at
+    # every metrics-flush boundary. Off = spans are shared no-ops.
+    spans: bool = True
+    # Also accumulate Chrome-trace events and write
+    # <out_dir>/<name>/trace.json at the end of fit (open in
+    # ui.perfetto.dev or chrome://tracing). Requires spans.
+    trace: bool = False
+    # Per-round communication byte counters (analytic wire model:
+    # upload/download, pre/post compression — obs/counters.py) merged
+    # into each round's JSONL record.
+    counters: bool = True
+    # Poll jax device memory stats at flush boundaries and log a
+    # `device_memory` record (in-use / peak / limit bytes). Off by
+    # default: the gauges are per-process globals, noisy under tests.
+    device_memory: bool = False
+    # NaN/Inf (+ optional divergence) monitoring over the per-round
+    # training loss — free, the loss is fetched anyway at flush.
+    health: bool = True
+    # Also probe the PARAMS for finiteness at flush boundaries (one
+    # device fetch per flush window; run.sanitize does it per round).
+    params_check: bool = False
+    # 0 = off; otherwise flag `divergence` when a round's loss exceeds
+    # factor × the best loss seen so far. Must be > 1 when set.
+    divergence_factor: float = 0.0
+    # What to do on an unhealthy round:
+    #   warn             — log the health event, keep training
+    #   abort            — raise HealthAbortError (NOT retried by
+    #                      run.max_retries: a NaN run re-NaNs)
+    #   checkpoint_abort — save a post-mortem checkpoint first
+    on_unhealthy: str = "warn"  # warn | abort | checkpoint_abort
+
+
+@dataclass
 class RunConfig:
     seed: int = 0
     # sharded: the shard_map/psum round engine (one XLA program per round)
@@ -422,6 +462,8 @@ class RunConfig:
     # BASELINE.md profile) while server aggregation and the cross-round
     # trajectory stay f32.
     local_param_dtype: str = ""
+    # Observability block (spans / counters / health) — see ObsConfig.
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 # the federated algorithms the driver implements (validate() + docs)
@@ -1034,6 +1076,24 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown run.local_param_dtype {self.run.local_param_dtype!r}"
             )
+        obs = self.run.obs
+        if obs.on_unhealthy not in ("warn", "abort", "checkpoint_abort"):
+            raise ValueError(
+                f"unknown run.obs.on_unhealthy {obs.on_unhealthy!r}; "
+                f"expected warn | abort | checkpoint_abort"
+            )
+        if obs.divergence_factor != 0.0 and obs.divergence_factor <= 1.0:
+            # a factor in (0, 1] would flag every round at or above the
+            # best loss — i.e. immediately and forever
+            raise ValueError(
+                f"run.obs.divergence_factor must be 0 (off) or > 1, "
+                f"got {obs.divergence_factor}"
+            )
+        if obs.trace and not obs.spans:
+            raise ValueError(
+                "run.obs.trace=true requires run.obs.spans=true (the "
+                "trace is built from the spans)"
+            )
         return self
 
     # ---- serialization ------------------------------------------------
@@ -1064,6 +1124,7 @@ class ExperimentConfig:
             "dp": DPConfig,
             "attack": AttackConfig,
             "run": RunConfig,
+            "obs": ObsConfig,  # nested under run
         }
         return build(cls, d)
 

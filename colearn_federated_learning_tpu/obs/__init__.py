@@ -1,0 +1,30 @@
+"""Observability layer (round-lifecycle telemetry).
+
+Three pillars, each its own module, all host-side and engine-agnostic:
+
+- :mod:`spans` — a low-overhead context-manager tracer for the round
+  lifecycle (host inputs → placement → dispatch → fetch → eval →
+  checkpoint, plus engine sub-phases), with per-phase aggregation into
+  the metrics JSONL and an optional Chrome-trace/Perfetto export.
+  Retraces are attributed via ``jax.monitoring`` compile hooks.
+- :mod:`counters` — per-round communication byte accounting (pre/post
+  compression, uplink + downlink) and device-memory polling.
+- :mod:`health` — NaN/Inf + divergence monitoring over the per-round
+  loss with configurable abort / checkpoint-and-abort actions.
+
+Everything is configured through the ``run.obs`` config block
+(:class:`~colearn_federated_learning_tpu.config.ObsConfig`); the
+``colearn summarize`` CLI subcommand (:mod:`summary`) aggregates a
+run's JSONL into a per-phase timing/throughput table.
+"""
+
+from colearn_federated_learning_tpu.obs.counters import (  # noqa: F401
+    device_memory_stats,
+    gossip_round_bytes,
+    round_comm_bytes,
+)
+from colearn_federated_learning_tpu.obs.health import (  # noqa: F401
+    HealthAbortError,
+    HealthMonitor,
+)
+from colearn_federated_learning_tpu.obs.spans import Tracer  # noqa: F401

@@ -1,0 +1,109 @@
+"""Per-round communication + device-memory counters.
+
+The byte accounting is an **analytic wire model**, not a measurement:
+the simulator never serializes client messages, so the honest number is
+the bytes the configured protocol WOULD move — a pure function of the
+config, the model size, and the round's realized participation. That
+purity is what makes the sharded and sequential engines agree bit-for-
+bit on the counters (pinned by ``tests/test_obs.py``), and what lets
+``summarize`` report a run's total traffic without replaying it.
+
+Model, per participating client:
+
+- uplink raw: one params-sized delta at the server param dtype.
+- uplink wire: ``secure_aggregation`` ships dense int32 (4 B/coord —
+  masking IS the wire format); ``topk`` ships k (value, index) pairs at
+  8 B each; ``qsgd`` ships ~(1 sign + ⌈log2 levels⌉) bits/coord (the
+  per-tensor norm scalars are noise at model scale and ignored);
+  otherwise the raw delta.
+- downlink raw: one params-sized broadcast per client that STARTED the
+  round (dropouts downloaded before failing; stragglers too).
+- downlink wire: ``downlink_compression='qsgd'`` quantizes the
+  broadcast the same way; otherwise raw.
+
+Gossip has no server: per mixing sweep each client exchanges its
+boundary replica rows with two ring neighbours (or everything under
+``full``), so the modeled traffic is symmetric — reported as equal
+upload/download halves of the sweep volume.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+
+def _qsgd_bits(levels: int) -> int:
+    # sign bit + level index; levels=1 degenerates to sign-only
+    return 1 + max(1, math.ceil(math.log2(max(levels, 2))))
+
+
+def round_comm_bytes(server, n_participants: int, n_downloads: int,
+                     n_coords: int, param_bytes: int) -> Dict[str, int]:
+    """Wire/raw upload+download bytes for one centralized round.
+
+    ``server`` is a :class:`~colearn_federated_learning_tpu.config.
+    ServerConfig`; ``n_participants`` is the number of clients whose
+    update actually aggregates (dropouts excluded), ``n_downloads`` the
+    number that received the broadcast (the real — non-pad — cohort).
+    """
+    if server.secure_aggregation:
+        up_wire = n_coords * 4  # dense int32 masked fixed-point
+    elif server.compression == "topk":
+        k = max(1, int(round(server.compression_topk_ratio * n_coords)))
+        up_wire = k * 8  # 4 B value + 4 B index per kept coordinate
+    elif server.compression == "qsgd":
+        up_wire = math.ceil(
+            n_coords * _qsgd_bits(server.compression_qsgd_levels) / 8
+        )
+    else:
+        up_wire = param_bytes
+    if server.downlink_compression == "qsgd":
+        down_wire = math.ceil(
+            n_coords * _qsgd_bits(server.downlink_qsgd_levels) / 8
+        )
+    else:
+        down_wire = param_bytes
+    return {
+        "upload_bytes": int(n_participants) * up_wire,
+        "upload_bytes_raw": int(n_participants) * param_bytes,
+        "download_bytes": int(n_downloads) * down_wire,
+        "download_bytes_raw": int(n_downloads) * param_bytes,
+    }
+
+
+def gossip_round_bytes(num_clients: int, mixing_steps: int, topology: str,
+                       param_bytes: int) -> Dict[str, int]:
+    """Symmetric neighbour-exchange traffic for one gossip round: under
+    ``ring`` each client sends its replica to 2 neighbours per sweep;
+    under ``full`` every sweep is an all-to-all average (modeled as one
+    replica broadcast per client per sweep — the allreduce-equivalent
+    volume, not N² point-to-point)."""
+    fan_out = 2 if topology == "ring" else 1
+    vol = int(num_clients) * fan_out * int(mixing_steps) * param_bytes
+    return {
+        "upload_bytes": vol,
+        "upload_bytes_raw": vol,
+        "download_bytes": vol,
+        "download_bytes_raw": vol,
+    }
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Current device-memory gauges from ``jax`` memory stats, or ``{}``
+    when the backend reports none (CPU, older runtimes)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    for src, dst in (
+        ("bytes_in_use", "hbm_in_use_bytes"),
+        ("peak_bytes_in_use", "hbm_peak_bytes"),
+        ("bytes_limit", "hbm_limit_bytes"),
+    ):
+        if src in stats:
+            out[dst] = int(stats[src])
+    return out

@@ -1,0 +1,76 @@
+"""Run-health monitoring: NaN/Inf and divergence detection.
+
+The monitor is a pure observer — it inspects the per-round loss (which
+the driver fetches anyway at flush boundaries, so the checks are free)
+and the optional params finiteness probe, and returns structured
+``health`` event records. POLICY stays in the driver: it logs the
+events and, per ``run.obs.on_unhealthy``, continues (``warn``), raises
+:class:`HealthAbortError` (``abort``), or saves a checkpoint first
+(``checkpoint_abort`` — the post-mortem artifact: the last healthy
+params plus the poisoned trajectory's provenance in the JSONL).
+
+:class:`HealthAbortError` is deliberately NOT retried by the driver's
+``run.max_retries`` failure recovery: a diverged/NaN run restored from
+its own checkpoint re-diverges — retrying would burn the retry budget
+hiding the signal the monitor exists to surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+
+class HealthAbortError(RuntimeError):
+    """The health monitor's configured action was to abort the run."""
+
+
+class HealthMonitor:
+    """Tracks the loss trajectory and flags unhealthy rounds.
+
+    - ``non_finite_loss``: the round's training loss is NaN/Inf.
+    - ``divergence``: ``divergence_factor > 0`` and the loss exceeds
+      ``factor × best-so-far`` (best is the running minimum, so a noisy
+      warmup cannot permanently raise the bar).
+    - ``non_finite_params``: reported by the driver's params probe
+      (``run.obs.params_check`` / ``run.sanitize``).
+    """
+
+    def __init__(self, divergence_factor: float = 0.0):
+        self.divergence_factor = float(divergence_factor)
+        self._best: Optional[float] = None
+
+    def observe_loss(self, round_idx: int, loss: float) -> Optional[Dict[str, Any]]:
+        """Feed one round's training loss; returns a ``health`` event
+        record when the round is unhealthy, else None."""
+        if not math.isfinite(loss):
+            return {
+                "event": "health",
+                "kind": "non_finite_loss",
+                "round": int(round_idx),
+                "loss": repr(loss),
+            }
+        if self.divergence_factor > 0.0 and self._best is not None:
+            bound = self.divergence_factor * self._best
+            if loss > bound:
+                return {
+                    "event": "health",
+                    "kind": "divergence",
+                    "round": int(round_idx),
+                    "loss": loss,
+                    "best_loss": self._best,
+                    "factor": self.divergence_factor,
+                }
+        if self._best is None or loss < self._best:
+            self._best = loss
+        return None
+
+    def observe_params_finite(self, round_idx: int,
+                              finite: bool) -> Optional[Dict[str, Any]]:
+        if finite:
+            return None
+        return {
+            "event": "health",
+            "kind": "non_finite_params",
+            "round": int(round_idx),
+        }

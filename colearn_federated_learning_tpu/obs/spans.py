@@ -1,0 +1,209 @@
+"""Phase-span tracer for the round lifecycle.
+
+Design constraints, in order:
+
+1. **Off is free.** With ``run.obs.spans=false`` a ``span()`` call
+   returns a shared no-op context manager — no clock reads, no
+   allocation — so the round loop's hot path pays one attribute check.
+2. **On is cheap.** An enabled span is two ``perf_counter`` reads and
+   one dict update under a lock (spans fire from the fit loop AND the
+   stream-prefetch worker thread). Chrome-trace event objects are only
+   built when ``run.obs.trace=true``.
+3. **Drain-at-flush.** The driver drains per-phase aggregates at its
+   metrics-flush boundaries and logs ONE ``spans`` record per window —
+   the JSONL stays one-line-per-round-scale, not one-line-per-span.
+
+Retrace attribution: ``jax.monitoring`` fires a
+``.../backend_compile_duration`` event for every XLA compilation; a
+module-level listener forwards those into every live tracer, so an
+unexpected mid-run retrace shows up as a ``compile`` pseudo-phase in
+the same window it stalled (and as a timeline block in the trace).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+# live tracers the jax.monitoring compile listener forwards into; weak
+# so finished Experiments don't accumulate across a process's lifetime
+_ACTIVE: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
+_LISTENER_INSTALLED = False
+
+
+def _on_event_duration(event, duration, **kw):
+    if "backend_compile" not in event:
+        return
+    for tracer in list(_ACTIVE):
+        tracer._note_compile(float(duration))
+
+
+def _install_listener() -> None:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    _LISTENER_INSTALLED = True  # never retry a failed install per call
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:
+        pass  # no jax / no monitoring API: spans still work, no retrace attribution
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_start")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self._tracer = tracer
+        self._name = name
+
+    def __enter__(self):
+        self._start = self._tracer._clock()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._record(self._name, self._start, self._tracer._clock())
+        return False
+
+
+class Tracer:
+    """Aggregating span tracer with optional Chrome-trace export.
+
+    ``span(name)`` is a context manager; nesting is expressed naturally
+    (a child span's interval lies inside its parent's) and survives into
+    the exported trace because complete ("X") events on the same thread
+    track stack in Perfetto's flame view.
+    """
+
+    def __init__(self, enabled: bool = True, trace: bool = False, clock=None):
+        self.enabled = enabled
+        self.trace = trace and enabled
+        self._clock = clock or time.perf_counter
+        self._lock = threading.Lock()
+        self._agg: Dict[str, List[float]] = {}  # name -> [count, total_s, max_s]
+        self._events: List[Dict[str, Any]] = []
+        self._t0 = self._clock()
+        self._compiles = 0
+        self._compile_secs = 0.0
+        self._compile_max = 0.0
+        if enabled:
+            _install_listener()
+            _ACTIVE.add(self)
+
+    # ------------------------------------------------------------------
+
+    def span(self, name: str):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def _record(self, name: str, start: float, end: float) -> None:
+        dur = end - start
+        with self._lock:
+            agg = self._agg.get(name)
+            if agg is None:
+                self._agg[name] = [1, dur, dur]
+            else:
+                agg[0] += 1
+                agg[1] += dur
+                if dur > agg[2]:
+                    agg[2] = dur
+            if self.trace:
+                self._events.append({
+                    "name": name,
+                    "ph": "X",
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() & 0xFFFF,
+                    "ts": (start - self._t0) * 1e6,  # µs, run-relative
+                    "dur": dur * 1e6,
+                })
+
+    def _note_compile(self, duration: float) -> None:
+        with self._lock:
+            self._compiles += 1
+            self._compile_secs += duration
+            if duration > self._compile_max:
+                self._compile_max = duration
+            if self.trace:
+                now = self._clock()
+                self._events.append({
+                    "name": "compile",
+                    "ph": "X",
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() & 0xFFFF,
+                    # the monitoring hook fires at compile END; back-date
+                    # the block so the timeline shows when it ran
+                    "ts": max(0.0, (now - self._t0 - duration)) * 1e6,
+                    "dur": duration * 1e6,
+                })
+
+    # ------------------------------------------------------------------
+
+    def drain(self) -> Dict[str, Dict[str, float]]:
+        """Return and reset the per-phase aggregates since the last
+        drain: ``{phase: {count, total_ms, max_ms}}``, with compiles
+        (retraces included) reported as the ``compile`` pseudo-phase."""
+        with self._lock:
+            agg, self._agg = self._agg, {}
+            compiles, self._compiles = self._compiles, 0
+            csecs, self._compile_secs = self._compile_secs, 0.0
+            cmax, self._compile_max = self._compile_max, 0.0
+        out = {
+            name: {
+                "count": int(c),
+                "total_ms": round(t * 1000.0, 3),
+                "max_ms": round(m * 1000.0, 3),
+            }
+            for name, (c, t, m) in sorted(agg.items())
+        }
+        if compiles:
+            out["compile"] = {
+                "count": compiles,
+                "total_ms": round(csecs * 1000.0, 3),
+                "max_ms": round(cmax * 1000.0, 3),
+            }
+        return out
+
+    def export(self, path: str) -> Optional[str]:
+        """Write the accumulated Chrome-trace events as a Perfetto-
+        loadable ``trace.json`` (open at ui.perfetto.dev or
+        chrome://tracing). Returns the path, or None when tracing is
+        off. Events are NOT cleared — export is an end-of-run dump."""
+        if not self.trace:
+            return None
+        with self._lock:
+            events = list(self._events)
+        doc = {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"ph": "M", "pid": os.getpid(), "name": "process_name",
+                 "args": {"name": "colearn round lifecycle"}},
+                *events,
+            ],
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path

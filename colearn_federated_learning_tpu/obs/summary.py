@@ -1,0 +1,193 @@
+"""Run-summary aggregation: metrics JSONL → per-phase timing table.
+
+``colearn summarize <run>`` makes a finished (or in-flight) run
+inspectable without TensorBoard or a trace viewer: it folds every
+``spans`` record into one per-phase table (count / total / mean / max /
+share of the round loop), totals the communication counters, and
+surfaces health/retry/profile events. Pure stdlib — importable (and
+fast) without touching a jax backend, so the CLI wires it up before
+device initialization.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List
+
+
+def resolve_metrics_path(run: str, out_dir: str = "runs") -> str:
+    """Resolve a ``summarize`` argument to a metrics JSONL path: a file
+    path as-is, a directory's newest ``*.metrics.jsonl``, else
+    ``<out_dir>/<run>.metrics.jsonl`` (the logger's layout)."""
+    if os.path.isfile(run):
+        return run
+    if os.path.isdir(run):
+        hits = sorted(
+            glob.glob(os.path.join(run, "*.metrics.jsonl")),
+            key=os.path.getmtime,
+        )
+        if not hits:
+            raise FileNotFoundError(f"no *.metrics.jsonl under {run!r}")
+        return hits[-1]
+    cand = os.path.join(out_dir, f"{run}.metrics.jsonl")
+    if os.path.isfile(cand):
+        return cand
+    raise FileNotFoundError(
+        f"cannot resolve run {run!r}: not a file, not a directory, and "
+        f"{cand!r} does not exist"
+    )
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail line from a crashed run is expected
+    return records
+
+
+_COUNTER_KEYS = (
+    "upload_bytes", "upload_bytes_raw", "download_bytes",
+    "download_bytes_raw",
+)
+
+
+def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a run's records into one summary dict (see format_summary)."""
+    phases: Dict[str, Dict[str, float]] = {}
+    counters: Dict[str, int] = {}
+    health: Dict[str, int] = {}
+    events: Dict[str, int] = {}
+    rounds = 0
+    rps: List[float] = []
+    last_eval: Dict[str, float] = {}
+    dropped = stragglers = byzantine = 0
+    for rec in records:
+        ev = rec.get("event")
+        if ev:
+            events[ev] = events.get(ev, 0) + 1
+        if ev == "spans":
+            for name, agg in (rec.get("phases") or {}).items():
+                cur = phases.setdefault(
+                    name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+                )
+                cur["count"] += int(agg.get("count", 0))
+                cur["total_ms"] += float(agg.get("total_ms", 0.0))
+                cur["max_ms"] = max(cur["max_ms"], float(agg.get("max_ms", 0.0)))
+            continue
+        if ev == "health":
+            kind = rec.get("kind", "?")
+            health[kind] = health.get(kind, 0) + 1
+            continue
+        if ev is None and "round" in rec:
+            rounds = max(rounds, int(rec["round"]))
+            if "rounds_per_sec" in rec:
+                rps.append(float(rec["rounds_per_sec"]))
+            for k in _COUNTER_KEYS:
+                if k in rec:
+                    counters[k] = counters.get(k, 0) + int(rec[k])
+            dropped += int(rec.get("dropped_clients", 0))
+            stragglers += int(rec.get("straggler_clients", 0))
+            byzantine += int(rec.get("byzantine_count", 0))
+            for k in ("eval_loss", "eval_acc"):
+                if k in rec:
+                    last_eval[k] = float(rec[k])
+    out: Dict[str, Any] = {
+        "rounds": rounds,
+        "phases": phases,
+        "events": events,
+    }
+    if rps:
+        out["rounds_per_sec_mean"] = sum(rps) / len(rps)
+    if counters:
+        out["comm"] = counters
+    if dropped or stragglers or byzantine:
+        out["failures"] = {
+            "dropped_clients": dropped,
+            "straggler_clients": stragglers,
+            "byzantine_sampled": byzantine,
+        }
+    if health:
+        out["health"] = health
+    if last_eval:
+        out["final_eval"] = last_eval
+    return out
+
+
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024.0 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024.0
+
+
+def format_summary(summary: Dict[str, Any], path: str = "") -> str:
+    """Render the summary as an aligned text table."""
+    lines = []
+    head = f"run: {path}" if path else "run summary"
+    head += f"  rounds: {summary['rounds']}"
+    if "rounds_per_sec_mean" in summary:
+        head += f"  rounds/sec (window mean): {summary['rounds_per_sec_mean']:.3f}"
+    lines.append(head)
+    phases = summary.get("phases") or {}
+    if phases:
+        # share is relative to the "round" parent span when present,
+        # else to the largest phase — nested children overlap, so the
+        # column reads "fraction of the round loop", not "sums to 100%"
+        base = phases.get("round", {}).get("total_ms") or max(
+            (p["total_ms"] for p in phases.values()), default=0.0
+        )
+        lines.append("")
+        lines.append(
+            f"{'phase':<24}{'count':>8}{'total s':>11}{'mean ms':>10}"
+            f"{'max ms':>10}{'share':>8}"
+        )
+        for name in sorted(phases, key=lambda n: -phases[n]["total_ms"]):
+            p = phases[name]
+            mean = p["total_ms"] / p["count"] if p["count"] else 0.0
+            share = p["total_ms"] / base if base else 0.0
+            lines.append(
+                f"{name:<24}{p['count']:>8}{p['total_ms'] / 1000.0:>11.3f}"
+                f"{mean:>10.2f}{p['max_ms']:>10.2f}{share:>7.0%} "
+            )
+    else:
+        lines.append("no span records (run.obs.spans was off, or pre-obs run)")
+    comm = summary.get("comm")
+    if comm:
+        lines.append("")
+        lines.append(
+            "comm: upload "
+            f"{_fmt_bytes(comm.get('upload_bytes', 0))} wire / "
+            f"{_fmt_bytes(comm.get('upload_bytes_raw', 0))} raw, download "
+            f"{_fmt_bytes(comm.get('download_bytes', 0))} wire / "
+            f"{_fmt_bytes(comm.get('download_bytes_raw', 0))} raw"
+        )
+    fails = summary.get("failures")
+    if fails:
+        lines.append(
+            f"failures: {fails['dropped_clients']} dropped, "
+            f"{fails['straggler_clients']} stragglers, "
+            f"{fails['byzantine_sampled']} byzantine-sampled"
+        )
+    health = summary.get("health")
+    if health:
+        kinds = ", ".join(f"{k}×{v}" for k, v in sorted(health.items()))
+        lines.append(f"health events: {kinds}")
+    ev = summary.get("final_eval")
+    if ev:
+        parts = ", ".join(f"{k}={v:.4f}" for k, v in sorted(ev.items()))
+        lines.append(f"final eval: {parts}")
+    return "\n".join(lines)
+
+
+def summarize_path(path: str) -> str:
+    return format_summary(summarize_records(load_records(path)), path)

@@ -227,9 +227,10 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
                 ).astype(r.dtype),
                 gathered, replicas,
             )
-            _, (trained_chunk, losses) = jax.lax.scan(
-                per_row, 0.0, (chunk, idx, mask, keys)
-            )
+            with jax.named_scope("gossip_local_train"):
+                _, (trained_chunk, losses) = jax.lax.scan(
+                    per_row, 0.0, (chunk, idx, mask, keys)
+                )
             if attack:
                 # poison the cohort's uploads before the scatter — the
                 # byz mask is cohort-aligned ([K], sharded like n_ex)
@@ -246,9 +247,10 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             )
         else:
             # full participation: every row trains from its own params
-            _, (trained, losses) = jax.lax.scan(
-                per_row, 0.0, (replicas, idx, mask, keys)
-            )
+            with jax.named_scope("gossip_local_train"):
+                _, (trained, losses) = jax.lax.scan(
+                    per_row, 0.0, (replicas, idx, mask, keys)
+                )
             if attack:
                 # byz mask is [N], sharded — this lane poisons its rows
                 trained = _poison(trained, replicas, byz, keys)
@@ -296,23 +298,28 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             )
 
         sweep = sweep_ring if topology == "ring" else sweep_full
-        mixed = trained
-        for _ in range(mixing_steps):
-            mixed = sweep(mixed)
+        # named scopes put the gossip sub-phases (local train vs mixing
+        # vs consensus) on the device profile — the round is one XLA
+        # program, so in-trace attribution is the only attribution
+        with jax.named_scope("gossip_mix"):
+            mixed = trained
+            for _ in range(mixing_steps):
+                mixed = sweep(mixed)
 
         # --- consensus diagnostics + the mean for eval ----------------
-        mean_params = jax.tree.map(
-            lambda a: jax.lax.psum(a.sum(0), CLIENT_AXIS) / float(num_clients),
-            mixed,
-        )
-        dist = sum(
-            jax.lax.psum(
-                jnp.sum((a.astype(jnp.float32)
-                         - m[None].astype(jnp.float32)) ** 2),
-                CLIENT_AXIS,
+        with jax.named_scope("gossip_consensus"):
+            mean_params = jax.tree.map(
+                lambda a: jax.lax.psum(a.sum(0), CLIENT_AXIS) / float(num_clients),
+                mixed,
             )
-            for a, m in zip(jax.tree.leaves(mixed), jax.tree.leaves(mean_params))
-        ) / float(num_clients)
+            dist = sum(
+                jax.lax.psum(
+                    jnp.sum((a.astype(jnp.float32)
+                             - m[None].astype(jnp.float32)) ** 2),
+                    CLIENT_AXIS,
+                )
+                for a, m in zip(jax.tree.leaves(mixed), jax.tree.leaves(mean_params))
+            ) / float(num_clients)
         w = n_ex.astype(jnp.float32)
         w_sum = jax.lax.psum(w.sum(), CLIENT_AXIS)
         l_sum = jax.lax.psum((w * losses).sum(), CLIENT_AXIS)

@@ -30,6 +30,14 @@ from colearn_federated_learning_tpu.data.loader import (
     make_round_indices,
 )
 from colearn_federated_learning_tpu.models import build_model
+from colearn_federated_learning_tpu.obs import (
+    HealthAbortError,
+    HealthMonitor,
+    Tracer,
+    device_memory_stats,
+    gossip_round_bytes,
+    round_comm_bytes,
+)
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
 from colearn_federated_learning_tpu.parallel.round_engine import (
     make_async_round_fn,
@@ -150,6 +158,14 @@ class Experiment:
                 1 + (ranks * s) // max(len(work), 1)
             ).astype(np.int32)
         self._async_stats: Dict[int, float] = {}
+        # observability (run.obs, obs/): per-round comm-byte and
+        # failure-count stats keyed by round (host-side, popped at
+        # flush); the tracer + health monitor are built after the
+        # logger below. _param_stats_cache backs both the HBM
+        # pre-flight and the comm-byte model.
+        self._param_stats_cache = None
+        self._comm_stats: Dict[int, Dict[str, int]] = {}
+        self._fail_stats: Dict[int, Dict[str, int]] = {}
         # Byzantine adversary simulation (AttackConfig, server/attacks.py):
         # the compromised id set is a deterministic pure function of
         # (run.seed, num_clients, fraction) — fixed for the whole run,
@@ -433,6 +449,18 @@ class Experiment:
             append=cfg.run.resume,
             tensorboard=cfg.run.tensorboard,
         )
+        # Round-lifecycle telemetry (run.obs, obs/): the tracer times
+        # host phases (and attributes retraces via compile hooks); the
+        # health monitor watches the fetched losses at flush
+        # boundaries. Trace export is single-writer like the JSONL.
+        obs = cfg.run.obs
+        self.tracer = Tracer(
+            enabled=obs.spans, trace=obs.trace and self._primary
+        )
+        self.health = (
+            HealthMonitor(obs.divergence_factor) if obs.health else None
+        )
+        self._counters_on = obs.counters
 
         # Host-side round-input construction: the C++ threaded pipeline
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
@@ -519,28 +547,36 @@ class Experiment:
                     f"risk explicitly"
                 )
 
-    def _param_bytes(self) -> int:
-        """Bytes of one params tree at run.param_dtype, via eval_shape
-        (no compute, no device memory — shapes only)."""
-        from colearn_federated_learning_tpu.client.trainer import (
-            normalize_input,
-        )
+    def _param_stats(self) -> tuple:
+        """(n_coords, bytes) of one params tree at run.param_dtype, via
+        eval_shape (no compute, no device memory — shapes only). Cached:
+        the HBM pre-flight and the per-round comm-byte model share it."""
+        if self._param_stats_cache is None:
+            from colearn_federated_learning_tpu.client.trainer import (
+                normalize_input,
+            )
 
-        dummy = jax.ShapeDtypeStruct(
-            (1,) + self.fed.train_x.shape[1:],
-            self.fed.train_x.dtype,  # LM corpora are int tokens — an
-            # f32 dummy would crash nn.Embed's integer check
-        )
-        shapes = jax.eval_shape(
-            lambda d: self.model.init(
-                jax.random.PRNGKey(0), normalize_input(d), train=False
-            )["params"],
-            dummy,
-        )
-        return sum(
-            int(np.prod(l.shape)) * l.dtype.itemsize
-            for l in jax.tree.leaves(shapes)
-        )
+            dummy = jax.ShapeDtypeStruct(
+                (1,) + self.fed.train_x.shape[1:],
+                self.fed.train_x.dtype,  # LM corpora are int tokens — an
+                # f32 dummy would crash nn.Embed's integer check
+            )
+            shapes = jax.eval_shape(
+                lambda d: self.model.init(
+                    jax.random.PRNGKey(0), normalize_input(d), train=False
+                )["params"],
+                dummy,
+            )
+            leaves = jax.tree.leaves(shapes)
+            coords = sum(int(np.prod(l.shape)) for l in leaves)
+            nbytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves
+            )
+            self._param_stats_cache = (coords, nbytes)
+        return self._param_stats_cache
+
+    def _param_bytes(self) -> int:
+        return self._param_stats()[1]
 
     def _check_memory_budget(self) -> None:
         """Construction-time HBM pre-flight (VERDICT r4 missing-#4):
@@ -797,7 +833,8 @@ class Experiment:
             idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
-        mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng)
+        mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng,
+                                          round_idx=round_idx)
         if self._poisson:
             cap, b = self._poisson_cap, len(cohort)
             if b > cap:
@@ -827,11 +864,14 @@ class Experiment:
         slab = self._stream_slab(idx) if self._stream else None
         return cohort, idx, mask, n_ex, slab
 
-    def _apply_failures(self, mask, n_ex, k, host_rng):
+    def _apply_failures(self, mask, n_ex, k, host_rng, round_idx=None):
         """Straggler truncation + dropout zeroing — shared by the sync
-        cohort path and the async (fedbuff) scheduler."""
+        cohort path and the async (fedbuff) scheduler. Realized counts
+        are recorded per round for the telemetry counters (this runs on
+        the prefetch worker thread too; dict stores are atomic)."""
         if k == 0:
             return mask, n_ex  # empty poisson round: nothing to fail
+        n_strag = n_drop = 0
         if self.cfg.server.straggler_rate > 0:
             # simulated stragglers (SURVEY.md §5, FedProx's motivating
             # scenario): a fraction of the cohort completes only
@@ -847,6 +887,7 @@ class Experiment:
                 mask = mask.copy()
                 mask[strag, done:, :] = 0.0
                 n_ex = mask.sum((1, 2))
+                n_strag = int(strag.sum())
         if self.cfg.server.dropout_rate > 0:
             # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
             participate = (
@@ -863,14 +904,24 @@ class Experiment:
                 # the decentralized dropout semantics)
                 mask = mask.copy()
                 mask[~participate] = 0.0
+            n_drop = int(k - participate.sum())
+        if (round_idx is not None and self._counters_on
+                and (n_strag or n_drop)):
+            self._fail_stats[round_idx] = {
+                "straggler_clients": n_strag,
+                "dropped_clients": n_drop,
+            }
         return mask, n_ex
 
     def _round_inputs(self, round_idx: int):
         fut = self._prefetch.pop(round_idx, None)
-        if fut is not None:
-            cohort, idx, mask, n_ex, slab = fut.result()
-        else:
-            cohort, idx, mask, n_ex, slab = self._host_inputs(round_idx)
+        # the span measures the CRITICAL-PATH host-input cost: ~0 when
+        # the prefetch worker ran ahead, the full build otherwise
+        with self.tracer.span("round.host_inputs"):
+            if fut is not None:
+                cohort, idx, mask, n_ex, slab = fut.result()
+            else:
+                cohort, idx, mask, n_ex, slab = self._host_inputs(round_idx)
         if self._stream and self._host_executor is None:
             # slab gathering is the heavy host work in stream mode; build
             # round r+1's slab on a worker thread while the device runs r
@@ -882,18 +933,38 @@ class Experiment:
         if (self._host_executor is not None and nxt < self.cfg.server.num_rounds
                 and nxt not in self._prefetch):
             self._prefetch[nxt] = self._host_executor.submit(self._host_inputs, nxt)
-        if slab is not None:
-            idx, slab_x, slab_y = slab
-            train_x = self._put_data(jnp.asarray(slab_x))
-            train_y = self._put_data(jnp.asarray(slab_y))
-        else:
-            train_x, train_y = self.train_x, self.train_y
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
-        if self._cohort_sharding is not None:
-            idx = self._put(idx, self._cohort_sharding)
-            mask = self._put(mask, self._cohort_sharding)
-            n_ex = self._put(n_ex, self._client_sharding)
+        if self._counters_on:
+            self._comm_stats[round_idx] = self._round_comm(cohort, n_host)
+        with self.tracer.span("round.placement"):
+            if slab is not None:
+                idx, slab_x, slab_y = slab
+                train_x = self._put_data(jnp.asarray(slab_x))
+                train_y = self._put_data(jnp.asarray(slab_y))
+            else:
+                train_x, train_y = self.train_x, self.train_y
+            if self._cohort_sharding is not None:
+                idx = self._put(idx, self._cohort_sharding)
+                mask = self._put(mask, self._cohort_sharding)
+                n_ex = self._put(n_ex, self._client_sharding)
         return cohort, idx, mask, n_ex, train_x, train_y, n_host
+
+    def _round_comm(self, cohort, n_host) -> Dict[str, int]:
+        """Analytic wire bytes for one round (obs/counters.py): the
+        realized participant count (dropouts excluded) uploads, the
+        real — non-poisson-pad — cohort downloads."""
+        coords, p_bytes = self._param_stats()
+        if self.gossip:
+            return gossip_round_bytes(
+                self.fed.num_clients, self.cfg.server.gossip_mixing_steps,
+                self.cfg.server.gossip_topology, p_bytes,
+            )
+        return round_comm_bytes(
+            self.cfg.server,
+            n_participants=int((n_host > 0).sum()),
+            n_downloads=int((np.asarray(cohort) < self.fed.num_clients).sum()),
+            n_coords=coords, param_bytes=p_bytes,
+        )
 
     def _stream_slab(self, idx: np.ndarray):
         """Gather this round's unique example rows into a fixed-shape slab
@@ -901,16 +972,17 @@ class Experiment:
         index tensor into it. Tail rows past ``len(uniq)`` are left
         uninitialized — every remapped index points below ``len(uniq)``,
         so they are never gathered."""
-        uniq, inv = np.unique(idx, return_inverse=True)
-        assert len(uniq) <= self._slab_rows, (len(uniq), self._slab_rows)
-        slab_x = np.empty((self._slab_rows,) + self.fed.train_x.shape[1:],
-                          self.fed.train_x.dtype)
-        slab_y = np.empty((self._slab_rows,) + self.fed.train_y.shape[1:],
-                          self.fed.train_y.dtype)
-        slab_x[: len(uniq)] = self.fed.train_x[uniq]
-        slab_y[: len(uniq)] = self.fed.train_y[uniq]
-        new_idx = inv.reshape(idx.shape).astype(np.int32)
-        return new_idx, slab_x, slab_y
+        with self.tracer.span("round.stream_slab"):
+            uniq, inv = np.unique(idx, return_inverse=True)
+            assert len(uniq) <= self._slab_rows, (len(uniq), self._slab_rows)
+            slab_x = np.empty((self._slab_rows,) + self.fed.train_x.shape[1:],
+                              self.fed.train_x.dtype)
+            slab_y = np.empty((self._slab_rows,) + self.fed.train_y.shape[1:],
+                              self.fed.train_y.dtype)
+            slab_x[: len(uniq)] = self.fed.train_x[uniq]
+            slab_y[: len(uniq)] = self.fed.train_y[uniq]
+            new_idx = inv.reshape(idx.shape).astype(np.int32)
+            return new_idx, slab_x, slab_y
 
     def _run_async_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
         """One FedBuff server step: pop the K earliest-finishing in-flight
@@ -929,10 +1001,11 @@ class Experiment:
         version = round_idx
         host_rng = np.random.default_rng((cfg.run.seed, 6073, round_idx))
 
-        order = np.lexsort((state["queue_seq"], state["queue_finish"]))
-        pick = order[:k]
-        cohort = state["queue_clients"][pick].copy()
-        staleness = version - state["queue_versions"][pick]
+        with self.tracer.span("round.async_schedule"):
+            order = np.lexsort((state["queue_seq"], state["queue_finish"]))
+            pick = order[:k]
+            cohort = state["queue_clients"][pick].copy()
+            staleness = version - state["queue_versions"][pick]
         if not ((staleness >= 0).all() and (staleness <= 2 * s_max).all()):
             # a violated bound would gather params from a wrong/overwritten
             # ring slot with no runtime error — must survive python -O
@@ -943,8 +1016,14 @@ class Experiment:
         slots = (state["queue_versions"][pick] % window).astype(np.int32)
         self._async_stats[round_idx] = float(staleness.mean())
 
-        idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
-        mask, n_ex = self._apply_failures(mask, n_ex, k, host_rng)
+        with self.tracer.span("round.host_inputs"):
+            idx, mask, n_ex = make_round_indices(
+                self.fed, cohort, self.shape, host_rng
+            )
+            mask, n_ex = self._apply_failures(mask, n_ex, k, host_rng,
+                                              round_idx=round_idx)
+        if self._counters_on:
+            self._comm_stats[round_idx] = self._round_comm(cohort, n_ex)
         base_w = (
             n_ex if self._agg_mode == "examples"
             else (n_ex > 0).astype(np.float32)
@@ -956,14 +1035,15 @@ class Experiment:
 
         put_c = lambda a: self._put(jnp.asarray(a), self._client_sharding)  # noqa: E731
         rng = jax.random.fold_in(state["rng_key"], round_idx)
-        history, params, opt_state, metrics = self.round_fn(
-            state["history"], state["server_opt_state"],
-            self.train_x, self.train_y,
-            put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
-            put_c(n_ex), put_c(slots),
-            jnp.int32(version % window), jnp.int32((version + 1) % window),
-            rng,
-        )
+        with self.tracer.span("round.dispatch"):
+            history, params, opt_state, metrics = self.round_fn(
+                state["history"], state["server_opt_state"],
+                self.train_x, self.train_y,
+                put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
+                put_c(n_ex), put_c(slots),
+                jnp.int32(version % window), jnp.int32((version + 1) % window),
+                rng,
+            )
 
         # replace the popped clients: fresh draws starting at the NEW
         # version, finishing 1..S steps from the next step
@@ -1057,10 +1137,11 @@ class Experiment:
                     jnp.asarray(np.asarray(cohort, np.int32)),
                     self._data_sharding,
                 ),)
-            replicas, mean_params, metrics = self.round_fn(
-                state["replicas"], train_x, train_y, idx, mask, n_ex, rng,
-                *extra, **akw,
-            )
+            with self.tracer.span("round.dispatch"):
+                replicas, mean_params, metrics = self.round_fn(
+                    state["replicas"], train_x, train_y, idx, mask, n_ex,
+                    rng, *extra, **akw,
+                )
             return {
                 "params": mean_params,
                 "server_opt_state": state["server_opt_state"],
@@ -1085,9 +1166,10 @@ class Experiment:
                     jnp.asarray(np.asarray(cohort, np.int32)),
                     self._data_sharding,
                 )
-                out = self.round_fn(
-                    *common, *glob, state["c_clients"], cohort_dev,
-                )
+                with self.tracer.span("round.dispatch"):
+                    out = self.round_fn(
+                        *common, *glob, state["c_clients"], cohort_dev,
+                    )
                 *head, c_clients, metrics = out
             else:
                 # sequential oracle: host-resident numpy store with an
@@ -1102,9 +1184,10 @@ class Experiment:
                 c_cohort = jax.tree.map(
                     lambda a: jnp.asarray(a[safe]), state["c_clients"]
                 )
-                out = self.round_fn(
-                    *common, *(glob or (None,)), c_cohort,
-                )
+                with self.tracer.span("round.dispatch"):
+                    out = self.round_fn(
+                        *common, *(glob or (None,)), c_cohort,
+                    )
                 *head, new_c_cohort, metrics = out
                 fetched = jax.device_get(new_c_cohort)
                 jax.tree.map(
@@ -1145,12 +1228,13 @@ class Experiment:
                         np.isin(np.asarray(c_j), self.compromised).sum()
                     )
             stack = lambda xs: jnp.stack([jnp.asarray(x) for x in xs])  # noqa: E731
-            params, opt_state, metrics = self.round_fn(
-                state["params"], state["server_opt_state"], train_x,
-                train_y, stack([c[0] for c in chunks]),
-                stack([c[1] for c in chunks]),
-                stack([c[2] for c in chunks]), jnp.stack(rngs),
-            )
+            with self.tracer.span("round.dispatch"):
+                params, opt_state, metrics = self.round_fn(
+                    state["params"], state["server_opt_state"], train_x,
+                    train_y, stack([c[0] for c in chunks]),
+                    stack([c[1] for c in chunks]),
+                    stack([c[2] for c in chunks]), jnp.stack(rngs),
+                )
             return {
                 "params": params,
                 "server_opt_state": opt_state,
@@ -1160,11 +1244,13 @@ class Experiment:
             }
         kw = dict(akw)
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
-            kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
-        params, opt_state, metrics = self.round_fn(
-            state["params"], state["server_opt_state"],
-            train_x, train_y, idx, mask, n_ex, rng, **kw,
-        )
+            with self.tracer.span("round.secagg_keys"):
+                kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
+        with self.tracer.span("round.dispatch"):
+            params, opt_state, metrics = self.round_fn(
+                state["params"], state["server_opt_state"],
+                train_x, train_y, idx, mask, n_ex, rng, **kw,
+            )
         return {
             "params": params,
             "server_opt_state": opt_state,
@@ -1270,6 +1356,12 @@ class Experiment:
                     return self._fit(state)
                 except KeyboardInterrupt:
                     raise
+                except HealthAbortError:
+                    # the monitor's configured abort is a VERDICT, not a
+                    # transient failure — a NaN/diverged run restored
+                    # from its own checkpoint re-diverges; retrying
+                    # would spend the retry budget hiding the signal
+                    raise
                 except Exception as e:  # noqa: BLE001 — failure recovery (§5)
                     if retries >= self.cfg.run.max_retries:
                         raise
@@ -1304,6 +1396,17 @@ class Experiment:
                     state = restored
         finally:
             self._stop_prefetch()
+            if self.tracer.trace and self.cfg.run.out_dir:
+                # end-of-fit Chrome-trace dump (aborted/failed runs
+                # included — the trace is the post-mortem artifact)
+                try:
+                    path = self.tracer.export(
+                        os.path.join(self._run_dir(), "trace.json")
+                    )
+                    if path:
+                        self.logger.log({"event": "trace", "path": path})
+                except Exception as e:
+                    print(f"trace export failed: {e}", flush=True)
             # flush + join the TensorBoard writer thread (no-op without TB)
             self.logger.close()
 
@@ -1408,22 +1511,69 @@ class Experiment:
         pending = []  # (round_idx, RoundMetrics-on-device)
         flush_t0 = time.perf_counter()
 
+        obs_cfg = cfg.run.obs
+
+        def flush_obs(last_round):
+            """Drain the tracer (+ device-memory gauges) into the JSONL
+            — one `spans` record per flush window, not per span."""
+            phases = self.tracer.drain()
+            if phases:
+                self.logger.log({
+                    "event": "spans", "round": last_round, "phases": phases,
+                })
+            if obs_cfg.device_memory:
+                mem = device_memory_stats()
+                if mem:
+                    self.logger.log(
+                        {"event": "device_memory", "round": last_round, **mem}
+                    )
+
+        def unhealthy(events, current_state):
+            """Apply the configured on_unhealthy policy to this window's
+            health events (already logged)."""
+            if not events or obs_cfg.on_unhealthy == "warn":
+                return
+            if obs_cfg.on_unhealthy == "checkpoint_abort" and store is not None:
+                with self.tracer.span("round.checkpoint"):
+                    self._write_state_kind()
+                    store.save(
+                        int(current_state["round"]),
+                        {k: v for k, v in current_state.items()
+                         if k != "wall_time"},
+                        force=True, block=True,
+                    )
+            flush_obs(int(current_state["round"]))
+            kinds = ", ".join(
+                f"{e['kind']}@round {e['round']}" for e in events
+            )
+            raise HealthAbortError(
+                f"run.obs.on_unhealthy={obs_cfg.on_unhealthy!r}: {kinds}"
+            )
+
         def flush(current_state):
             nonlocal flush_t0
             if not pending:
                 return
-            fetched = jax.device_get([m for _, m in pending])
+            with self.tracer.span("round.fetch"):
+                fetched = jax.device_get([m for _, m in pending])
             dt = time.perf_counter() - flush_t0
             rounds_per_sec = len(pending) / dt if dt > 0 else 0.0
             updates_per_sec = (
                 rounds_per_sec * cfg.server.cohort_size / self.n_chips
             )
+            health_events = []
             for (ridx, _), m in zip(pending, fetched):
                 record = {
                     "round": ridx + 1,
                     "train_loss": float(m.train_loss),
                     "examples": float(m.examples),
                 }
+                record.update(self._comm_stats.pop(ridx, ()))
+                record.update(self._fail_stats.pop(ridx, ()))
+                if self.health is not None:
+                    ev = self.health.observe_loss(ridx + 1, record["train_loss"])
+                    if ev is not None:
+                        health_events.append(ev)
                 if cfg.dp.enabled:
                     record["dp_epsilon"] = round(self.dp_epsilon(ridx + 1), 4)
                 if cfg.server.dp_client_noise_multiplier > 0.0:
@@ -1448,7 +1598,20 @@ class Experiment:
                     if cfg.server.eval_every and (ridx + 1) % cfg.server.eval_every == 0:
                         record.update(self.evaluate(current_state["params"]))
                 self.logger.log(record)
+            last_round = pending[-1][0] + 1
             pending.clear()
+            if self.health is not None and obs_cfg.params_check:
+                finite = all(
+                    bool(jnp.isfinite(x).all())
+                    for x in jax.tree.leaves(current_state["params"])
+                )
+                ev = self.health.observe_params_finite(last_round, finite)
+                if ev is not None:
+                    health_events.append(ev)
+            for ev in health_events:
+                self.logger.log(ev)
+            flush_obs(last_round)
+            unhealthy(health_events, current_state)
             flush_t0 = time.perf_counter()
 
         fuse = cfg.run.fuse_rounds if not (
@@ -1468,24 +1631,35 @@ class Experiment:
             profiling = r == cfg.run.profile_round
             if profiling:
                 flush(state)
-                jax.profiler.start_trace(os.path.join(self._run_dir(), "profile"))
-            state = self.run_round(state, r)
-            ms = state.pop("_metrics")
-            if fuse == 1:
-                pending.append((r, ms))
-            else:
-                # [F]-stacked fields from the fused scan: tiny device
-                # slices, drained at the same flush boundaries
-                pending.extend(
-                    (r + j, jax.tree.map(lambda a, j=j: a[j], ms))
-                    for j in range(fuse)
-                )
-            if profiling:
-                # A scalar fetch, not block_until_ready: on a relayed chip
-                # only a device_get truly forces execution, and the trace
-                # must contain the round's device compute.
-                jax.device_get(pending[-1][1].train_loss)
-                jax.profiler.stop_trace()
+                profile_dir = os.path.join(self._run_dir(), "profile")
+                jax.profiler.start_trace(profile_dir)
+            try:
+                with self.tracer.span("round"):
+                    state = self.run_round(state, r)
+                ms = state.pop("_metrics")
+                if fuse == 1:
+                    pending.append((r, ms))
+                else:
+                    # [F]-stacked fields from the fused scan: tiny device
+                    # slices, drained at the same flush boundaries
+                    pending.extend(
+                        (r + j, jax.tree.map(lambda a, j=j: a[j], ms))
+                        for j in range(fuse)
+                    )
+                if profiling:
+                    # A scalar fetch, not block_until_ready: on a relayed
+                    # chip only a device_get truly forces execution, and
+                    # the trace must contain the round's device compute.
+                    jax.device_get(pending[-1][1].train_loss)
+            finally:
+                if profiling:
+                    # stop on the error path too — a raise mid-profiled-
+                    # round must not leak an open trace session
+                    jax.profiler.stop_trace()
+                    self.logger.log({
+                        "event": "profile", "round": r + 1,
+                        "dir": profile_dir,
+                    })
             r_end = r + fuse  # validate() pins eval/ckpt to chunk ends
             at_eval = cfg.server.eval_every and r_end % cfg.server.eval_every == 0
             at_ckpt = store and cfg.server.checkpoint_every and r_end % cfg.server.checkpoint_every == 0
@@ -1496,20 +1670,31 @@ class Experiment:
                     bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(state["params"])
                 )
                 if not finite:
+                    if self.health is not None:
+                        # the structured twin of the raise below, so
+                        # post-mortems find it in the JSONL
+                        self.logger.log({
+                            "event": "health",
+                            "kind": "non_finite_params",
+                            "round": r_end,
+                        })
                     raise FloatingPointError(f"non-finite params after round {r_end}")
             if at_ckpt:
-                self._write_state_kind()
-                store.save(r_end, state)
+                with self.tracer.span("round.checkpoint"):
+                    self._write_state_kind()
+                    store.save(r_end, state)
                 flush_t0 = time.perf_counter()  # keep save time out of the next window
         flush(state)
         state["wall_time"] = time.perf_counter() - t_start
         if store:
             store.wait()  # land in-flight async saves before deciding
             if store.latest_step() != int(state["round"]):
-                self._write_state_kind()
-                store.save(int(state["round"]),
-                           {k: v for k, v in state.items() if k != "wall_time"},
-                           force=True, block=True)
+                with self.tracer.span("round.checkpoint"):
+                    self._write_state_kind()
+                    store.save(int(state["round"]),
+                               {k: v for k, v in state.items() if k != "wall_time"},
+                               force=True, block=True)
+        flush_obs(int(state["round"]))  # tail spans (final save, eval)
         return state
 
     # ------------------------------------------------------------------
@@ -1590,9 +1775,10 @@ class Experiment:
         return min(1.0, t * tail)
 
     def evaluate(self, params) -> Dict[str, float]:
-        xb, yb, mb = self._eval_data
-        loss, acc, n = jax.device_get(self._eval_all(params, xb, yb, mb))
-        return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
+        with self.tracer.span("round.eval"):
+            xb, yb, mb = self._eval_data
+            loss, acc, n = jax.device_get(self._eval_all(params, xb, yb, mb))
+            return {"eval_loss": float(loss / n), "eval_acc": float(acc / n)}
 
     def evaluate_federated(self, params, max_clients: int = 64,
                            seed: Optional[int] = None) -> Dict[str, float]:

@@ -23,6 +23,11 @@ _SECTIONS = [
      "Byzantine adversary simulation (in-loop attack injection)."),
     ("run", config_mod.RunConfig,
      "Engine/mesh/dtype/ops switches (profiling, retries, host pipeline)."),
+    ("run.obs", config_mod.ObsConfig,
+     "Observability: round-lifecycle phase spans (+ optional Chrome-trace "
+     "export), communication/device counters, and NaN/divergence health "
+     "monitoring with configurable abort. `colearn summarize <run>` "
+     "aggregates the resulting JSONL into a per-phase timing table."),
 ]
 
 # appended under the `attack` section table (kept here so the generated
@@ -68,6 +73,9 @@ def _fmt(v) -> str:
         return f'`"{v}"`' if v else '`""`'
     if isinstance(v, dict) and not v:
         return "`{}`"
+    if dataclasses.is_dataclass(v):
+        # nested config block: its own section carries the fields
+        return "(nested section below)"
     return f"`{v}`"
 
 

@@ -5,6 +5,14 @@ client-updates/sec/chip — BASELINE.json:2). The driver batches device
 metric fetches per flush window (``run.metrics_flush_every``) and
 computes throughput over those windows; this module is pure host-side
 bookkeeping.
+
+Record contract (``SCHEMA_VERSION``): every record carries a ``schema``
+version plus either ``round`` (per-round metrics) or ``event`` (spans,
+health, retries, provenance, ...) — ``log`` REJECTS free-form records
+with neither, so ``colearn summarize`` and downstream tooling can rely
+on the shape. The JSONL handle is opened once (line-buffered) and held
+until ``close()``; span/counter records fire far more often than the
+old once-per-round cadence and must not pay an open/close per line.
 """
 
 from __future__ import annotations
@@ -14,20 +22,28 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+# bump when a record's meaning changes incompatibly (key renames,
+# semantic changes) — adding new optional keys does not require a bump
+SCHEMA_VERSION = 1
+
 
 class MetricsLogger:
     def __init__(self, out_dir: Optional[str], run_name: str, echo: bool = True,
                  append: bool = False, tensorboard: bool = False):
         self.echo = echo
         self.path = None
+        self._fh = None
         self._tb = None
         self._tb_dir = None
+        self._truncate = False
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
             self.path = os.path.join(out_dir, f"{run_name}.metrics.jsonl")
-            if not append:
-                # one file per fresh run; resumed runs keep prior rounds
-                open(self.path, "w").close()
+            # one file per fresh run; resumed runs keep prior rounds.
+            # Truncation is DEFERRED to the first write: evaluate/export
+            # construct an Experiment (and its logger) too, and must not
+            # wipe the fit log `colearn summarize` reads.
+            self._truncate = not append
             if tensorboard:
                 self._tb_dir = os.path.join(out_dir, run_name, "tb")
         self.history = []
@@ -57,7 +73,7 @@ class MetricsLogger:
         values = [
             Summary.Value(tag=k, simple_value=float(v))
             for k, v in record.items()
-            if k not in ("round", "time") and isinstance(v, (int, float))
+            if k not in ("round", "time", "schema") and isinstance(v, (int, float))
             and not isinstance(v, bool)
         ]
         if values:
@@ -66,25 +82,40 @@ class MetricsLogger:
                       summary=Summary(value=values))
             )
 
+    def _handle(self):
+        # held line-buffered for the logger's lifetime (reopened in
+        # append mode after close() — the fit-after-fit pattern)
+        if self._fh is None:
+            mode = "w" if self._truncate else "a"
+            self._truncate = False
+            self._fh = open(self.path, mode, buffering=1)
+        return self._fh
+
     def log(self, record: Dict[str, Any]):
-        record = dict(record, time=time.time())
+        if "event" not in record and "round" not in record:
+            raise ValueError(
+                f"metrics record must carry 'event' or 'round' "
+                f"(SCHEMA_VERSION={SCHEMA_VERSION} contract): "
+                f"{sorted(record)}"
+            )
+        record = dict(record, time=time.time(), schema=SCHEMA_VERSION)
         self.history.append(record)
         if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(record) + "\n")
+            self._handle().write(json.dumps(record) + "\n")
         if self._tb_dir is not None and "round" in record:
             if self._tb is None:
                 self._open_tensorboard()
             if self._tb is not None:
                 self._tb_scalars(record)
         if self.echo:
-            shown = {k: v for k, v in record.items() if k != "time"}
+            shown = {k: v for k, v in record.items() if k not in ("time", "schema")}
             print(json.dumps(shown), flush=True)
 
     def close(self):
+        fh, self._fh = self._fh, None
+        if fh is not None:
+            fh.close()
         tb, self._tb = self._tb, None
         if tb is not None:
             tb.flush()
             tb.close()
-
-

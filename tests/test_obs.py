@@ -1,0 +1,390 @@
+"""Observability layer (obs/, run.obs): span nesting + trace
+well-formedness, analytic comm-counter parity between engines, the
+JSONL schema contract, health monitoring's NaN/divergence detection and
+abort paths, and the `summarize` aggregation the CLI serves."""
+
+import json
+import os
+
+import pytest
+
+from colearn_federated_learning_tpu import cli
+from colearn_federated_learning_tpu.config import (
+    ServerConfig,
+    get_named_config,
+)
+from colearn_federated_learning_tpu.obs import (
+    HealthAbortError,
+    HealthMonitor,
+    Tracer,
+    round_comm_bytes,
+)
+from colearn_federated_learning_tpu.obs.spans import _NULL_SPAN
+from colearn_federated_learning_tpu.obs.summary import (
+    format_summary,
+    load_records,
+    resolve_metrics_path,
+    summarize_records,
+)
+from colearn_federated_learning_tpu.utils.metrics import (
+    SCHEMA_VERSION,
+    MetricsLogger,
+)
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+def test_tracer_nesting_and_aggregation():
+    clock = iter(float(t) for t in range(100))
+    tracer = Tracer(enabled=True, trace=True, clock=lambda: next(clock))
+    # t0 consumed at construction; outer spans [1, 6], inner [2, 3]
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            pass
+        with tracer.span("inner"):
+            pass
+    agg = tracer.drain()
+    assert agg["outer"]["count"] == 1
+    assert agg["inner"]["count"] == 2
+    # inner spans each took 1 "second" on the fake clock
+    assert agg["inner"]["total_ms"] == pytest.approx(2000.0)
+    assert agg["inner"]["max_ms"] == pytest.approx(1000.0)
+    # drain resets
+    assert tracer.drain() == {}
+
+
+def test_tracer_trace_export_is_wellformed_and_nested(tmp_path):
+    clock = iter(float(t) for t in range(100))
+    tracer = Tracer(enabled=True, trace=True, clock=lambda: next(clock))
+    with tracer.span("parent"):
+        with tracer.span("child"):
+            pass
+    path = tracer.export(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"parent", "child"}
+    for e in events:
+        assert e["dur"] >= 0 and e["ts"] >= 0 and "pid" in e and "tid" in e
+    p, c = by_name["parent"], by_name["child"]
+    # the child's interval lies INSIDE the parent's (nesting survives
+    # into the trace, so Perfetto stacks them)
+    assert p["ts"] <= c["ts"]
+    assert c["ts"] + c["dur"] <= p["ts"] + p["dur"]
+
+
+def test_tracer_disabled_is_noop():
+    tracer = Tracer(enabled=False)
+    assert tracer.span("anything") is _NULL_SPAN  # shared singleton
+    with tracer.span("anything"):
+        pass
+    assert tracer.drain() == {}
+    assert tracer.export("/nonexistent/never-written.json") is None
+
+
+# ---------------------------------------------------------------------------
+# counters (pure wire model)
+
+
+def test_comm_bytes_uncompressed():
+    out = round_comm_bytes(ServerConfig(), n_participants=3, n_downloads=4,
+                           n_coords=1000, param_bytes=4000)
+    assert out == {
+        "upload_bytes": 12000, "upload_bytes_raw": 12000,
+        "download_bytes": 16000, "download_bytes_raw": 16000,
+    }
+
+
+def test_comm_bytes_topk_and_qsgd_and_secagg():
+    topk = round_comm_bytes(
+        ServerConfig(compression="topk", compression_topk_ratio=0.01),
+        n_participants=2, n_downloads=2, n_coords=10_000, param_bytes=40_000,
+    )
+    # 100 kept coords × (4 B value + 4 B index) per participant
+    assert topk["upload_bytes"] == 2 * 100 * 8
+    assert topk["upload_bytes_raw"] == 2 * 40_000
+
+    qsgd = round_comm_bytes(
+        ServerConfig(compression="qsgd", compression_qsgd_levels=256),
+        n_participants=1, n_downloads=1, n_coords=8000, param_bytes=32_000,
+    )
+    # 1 sign + 8 level bits = 9 bits/coord
+    assert qsgd["upload_bytes"] == (8000 * 9 + 7) // 8
+
+    sec = round_comm_bytes(
+        ServerConfig(secure_aggregation=True, clip_delta_norm=1.0),
+        n_participants=2, n_downloads=2, n_coords=1000, param_bytes=4000,
+    )
+    assert sec["upload_bytes"] == 2 * 1000 * 4  # dense int32 wire
+
+    down = round_comm_bytes(
+        ServerConfig(downlink_compression="qsgd", downlink_qsgd_levels=16),
+        n_participants=1, n_downloads=3, n_coords=800, param_bytes=3200,
+    )
+    assert down["download_bytes"] == 3 * ((800 * 5 + 7) // 8)
+    assert down["download_bytes_raw"] == 3 * 3200
+
+
+# ---------------------------------------------------------------------------
+# health monitor
+
+
+def test_health_monitor_nan_and_divergence():
+    mon = HealthMonitor(divergence_factor=2.0)
+    assert mon.observe_loss(1, 1.0) is None
+    assert mon.observe_loss(2, 0.5) is None  # improving
+    ev = mon.observe_loss(3, float("nan"))
+    assert ev["kind"] == "non_finite_loss" and ev["round"] == 3
+    ev = mon.observe_loss(4, 1.5)  # > 2 × best (0.5)
+    assert ev["kind"] == "divergence" and ev["best_loss"] == 0.5
+    assert mon.observe_loss(5, 0.9) is None  # within the band
+    ev = mon.observe_params_finite(6, False)
+    assert ev["kind"] == "non_finite_params"
+    assert mon.observe_params_finite(6, True) is None
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger contract (satellites: held handle + schema validation)
+
+
+def test_metrics_logger_holds_one_handle_and_reopens(tmp_path):
+    log = MetricsLogger(str(tmp_path), "run", echo=False)
+    log.log({"round": 1, "x": 1.0})
+    fh = log._fh
+    assert fh is not None
+    log.log({"round": 2, "x": 2.0})
+    assert log._fh is fh  # no reopen per record
+    log.close()
+    assert log._fh is None
+    log.log({"event": "late"})  # a close()d logger reopens (fit-after-fit)
+    log.close()
+    recs = [json.loads(l) for l in open(tmp_path / "run.metrics.jsonl")]
+    assert [r.get("round") for r in recs] == [1, 2, None]
+    assert all(r["schema"] == SCHEMA_VERSION for r in recs)
+
+
+def test_metrics_logger_truncates_lazily(tmp_path):
+    """An evaluate/export-style logger (constructed, never logged) must
+    not wipe the fit log summarize reads; a fresh run that DOES log
+    still gets its own file."""
+    log = MetricsLogger(str(tmp_path), "run", echo=False)
+    log.log({"round": 1})
+    log.close()
+    # evaluate-style: construct + close without logging → file intact
+    MetricsLogger(str(tmp_path), "run", echo=False).close()
+    recs = [json.loads(l) for l in open(tmp_path / "run.metrics.jsonl")]
+    assert [r["round"] for r in recs] == [1]
+    # a fresh run that logs truncates (one file per fresh run)
+    log = MetricsLogger(str(tmp_path), "run", echo=False)
+    log.log({"round": 7})
+    log.close()
+    recs = [json.loads(l) for l in open(tmp_path / "run.metrics.jsonl")]
+    assert [r["round"] for r in recs] == [7]
+
+
+def test_metrics_logger_rejects_freeform_records(tmp_path):
+    log = MetricsLogger(str(tmp_path), "run", echo=False)
+    with pytest.raises(ValueError, match="'event' or 'round'"):
+        log.log({"loss": 1.0})
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: fit → JSONL/trace → summarize
+
+
+def _tiny_cfg(tmp, engine="sharded", **overrides):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": 3, "server.eval_every": 3,
+        "server.cohort_size": 2,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 64, "client.batch_size": 16,
+        "run.out_dir": str(tmp), "run.metrics_flush_every": 2,
+        "run.engine": engine,
+        **overrides,
+    })
+    return cfg.validate()
+
+
+def _fit(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    path = os.path.join(cfg.run.out_dir, f"{cfg.name}.metrics.jsonl")
+    return exp, state, [json.loads(l) for l in open(path)], path
+
+
+def test_fit_emits_spans_counters_trace_and_summarizes(tmp_path, capsys):
+    cfg = _tiny_cfg(tmp_path, "sharded", **{"run.obs.trace": True})
+    exp, state, recs, path = _fit(cfg)
+    # schema contract: every record carries schema + event-or-round
+    assert recs, "no records logged"
+    for r in recs:
+        assert r["schema"] == SCHEMA_VERSION
+        assert "event" in r or "round" in r, r
+    # span records cover the lifecycle phases
+    phases = {}
+    for r in recs:
+        if r.get("event") == "spans":
+            for k, v in r["phases"].items():
+                phases[k] = phases.get(k, 0) + v["count"]
+    for name in ("round", "round.host_inputs", "round.placement",
+                 "round.dispatch", "round.fetch", "round.eval",
+                 "round.checkpoint"):
+        assert phases.get(name), f"missing span phase {name}: {phases}"
+    assert phases["round"] == cfg.server.num_rounds
+    # per-round comm counters ride the round records
+    rounds = [r for r in recs if "train_loss" in r]
+    assert len(rounds) == cfg.server.num_rounds
+    for r in rounds:
+        assert r["upload_bytes"] > 0 and r["download_bytes_raw"] > 0
+    # trace.json is a valid Chrome trace with round events
+    doc = json.load(open(os.path.join(tmp_path, cfg.name, "trace.json")))
+    names = {e.get("name") for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert "round" in names and "round.dispatch" in names
+    assert any(r.get("event") == "trace" for r in recs)
+    # summarize: module-level aggregation and the CLI table
+    summary = summarize_records(recs)
+    assert summary["rounds"] == cfg.server.num_rounds
+    assert summary["comm"]["upload_bytes"] == sum(r["upload_bytes"] for r in rounds)
+    table = format_summary(summary, path)
+    assert "round.dispatch" in table and "comm:" in table
+    assert cli.main(["summarize", path]) == 0
+    out = capsys.readouterr().out
+    assert "round.dispatch" in out and "phase" in out
+    # and by run name under --out-dir
+    assert cli.main(["summarize", cfg.name, "--out-dir", str(tmp_path)]) == 0
+
+
+def test_comm_counter_parity_sharded_vs_sequential(tmp_path):
+    """The analytic wire model is engine-independent BY CONSTRUCTION —
+    pin it: the same config under both engines logs identical per-round
+    byte counters (dropout changes realized participation; same seed ⇒
+    same realization)."""
+    outs = {}
+    for engine in ("sharded", "sequential"):
+        sub = tmp_path / engine
+        cfg = _tiny_cfg(sub, engine, **{
+            "server.eval_every": 0,
+            "server.dropout_rate": 0.4,
+            "server.compression": "qsgd",
+        })
+        _, _, recs, _ = _fit(cfg)
+        outs[engine] = [
+            {k: r.get(k, 0) for k in
+             ("round", "upload_bytes", "upload_bytes_raw",
+              "download_bytes", "download_bytes_raw", "dropped_clients")}
+            for r in recs if "train_loss" in r
+        ]
+    assert outs["sharded"] == outs["sequential"]
+    # compression makes wire < raw
+    assert all(r["upload_bytes"] < r["upload_bytes_raw"]
+               for r in outs["sharded"])
+
+
+def test_failure_counters_recorded(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "server.dropout_rate": 0.9,
+        "data.num_clients": 4, "server.cohort_size": 4,
+    })
+    _, _, recs, _ = _fit(cfg)
+    rounds = [r for r in recs if "train_loss" in r]
+    assert sum(r.get("dropped_clients", 0) for r in rounds) > 0
+
+
+def test_nan_triggers_health_event_and_abort(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "client.lr": 1e38,
+        "run.obs.on_unhealthy": "abort", "run.metrics_flush_every": 1,
+    })
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(HealthAbortError, match="non_finite_loss"):
+        exp.fit()
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, f"{cfg.name}.metrics.jsonl"))]
+    health = [r for r in recs if r.get("event") == "health"]
+    assert health and health[0]["kind"] == "non_finite_loss"
+
+
+def test_nan_checkpoint_abort_saves_postmortem(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "client.lr": 1e38,
+        "run.obs.on_unhealthy": "checkpoint_abort",
+        "run.metrics_flush_every": 1,
+    })
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(HealthAbortError):
+        exp.fit()
+    ckpt = os.path.join(tmp_path, cfg.name, "ckpt")
+    steps = [d for d in os.listdir(ckpt) if d.isdigit()]
+    assert steps, f"no post-mortem checkpoint in {ckpt}"
+
+
+def test_health_abort_is_not_retried(tmp_path):
+    """max_retries must NOT eat a health abort — a NaN run restored from
+    its own checkpoint re-NaNs; the verdict has to surface."""
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "client.lr": 1e38,
+        "run.obs.on_unhealthy": "abort", "run.metrics_flush_every": 1,
+        "run.max_retries": 3,
+    })
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(HealthAbortError):
+        exp.fit()
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, f"{cfg.name}.metrics.jsonl"))]
+    assert not any(r.get("event") == "retry" for r in recs)
+
+
+def test_divergence_detection_warn_keeps_training(tmp_path):
+    """A diverging (but finite) loss with the default on_unhealthy=warn
+    logs health events and completes the run."""
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "client.lr": 1e25,  # explodes, stays finite
+        "run.obs.divergence_factor": 1.5, "run.metrics_flush_every": 1,
+        "server.num_rounds": 4,
+    })
+    _, state, recs, _ = _fit(cfg)
+    assert int(state["round"]) == 4  # warn ⇒ the run completed
+    kinds = {r["kind"] for r in recs if r.get("event") == "health"}
+    assert "divergence" in kinds
+
+
+def test_profile_event_logged_and_trace_closed(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "run.profile_round": 1,
+    })
+    _, _, recs, _ = _fit(cfg)
+    prof = [r for r in recs if r.get("event") == "profile"]
+    assert prof and prof[0]["round"] == 2 and os.path.isdir(prof[0]["dir"])
+    import jax
+
+    # the profiler session was stopped (a second start would raise if
+    # the wrap leaked one open)
+    jax.profiler.start_trace(str(tmp_path / "p2"))
+    jax.profiler.stop_trace()
+
+
+def test_summary_resolution_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        resolve_metrics_path("no_such_run", out_dir=str(tmp_path))
+    assert cli.main(["summarize", "no_such_run",
+                     "--out-dir", str(tmp_path)]) == 2
+
+
+def test_summary_tolerates_torn_tail_line(tmp_path):
+    p = tmp_path / "x.metrics.jsonl"
+    p.write_text('{"round": 1, "train_loss": 1.0, "schema": 1}\n{"round": 2, "tr')
+    recs = load_records(str(p))
+    assert len(recs) == 1
+    assert summarize_records(recs)["rounds"] == 1
