@@ -322,7 +322,9 @@ class AttackConfig:
 
     Pairings rejected by validate() (with reasons): secure_aggregation,
     client-level DP, example-level DP, scaffold/feddyn, fedbuff,
-    error_feedback; upload attacks additionally reject fuse_rounds>1.
+    error_feedback. Upload attacks compose with run.fuse_rounds>1: the
+    per-round byzantine masks become a stacked [fuse, K] scan input and
+    the attacked delta stack stays private to the fused scan body.
     """
 
     # "" (off) | sign_flip | gauss | scale | alie | label_flip
@@ -404,10 +406,20 @@ class RunConfig:
     # lax.scan over the round body with stacked index tensors and the
     # same per-round rngs — fused ≡ unfused bitwise). Amortizes
     # per-round dispatch, THE dominant cost of tiny-model configs on a
-    # relayed chip (BASELINE.md r5). Plain weighted-mean path only
-    # (fedavg/fedprox; no stores/secagg/robust/stream); must divide
-    # num_rounds, eval_every and checkpoint_every so evals and saves
-    # land on fused-chunk boundaries. 1 = off.
+    # relayed chip (BASELINE.md r5). Covers the fedavg/fedprox family
+    # including robust aggregators (median/trimmed_mean/krum — the
+    # per-client delta stack stays private to the scan body), upload
+    # attacks (byzantine masks ride a stacked [fuse, K] scan input),
+    # error feedback (the residual store is a donated scan carry), and
+    # multi-process meshes (stacked host slabs place through the
+    # sharded path). Excluded: scaffold/feddyn/fedbuff/gossip (their
+    # state recursions / schedulers cannot ride the carry), secagg
+    # (per-round key-protocol host I/O), and data.placement=stream
+    # (slabs are built per round). Must divide num_rounds, eval_every
+    # and checkpoint_every so evals and saves land on fused-chunk
+    # boundaries; a resume at a non-chunk-aligned round runs unfused
+    # catch-up rounds to the next boundary (logged) and then re-enters
+    # the fused loop. 1 = off.
     fuse_rounds: int = 1
     # Persistent XLA compilation cache directory ("" = off): round-program
     # compiles (~40 s for ResNet, minutes for ViT-B+DP) are reused across
@@ -927,16 +939,22 @@ class ExperimentConfig:
             if self.algorithm not in ("fedavg", "fedprox"):
                 raise ValueError(
                     "fuse_rounds > 1 supports fedavg/fedprox only "
-                    "(per-round store scatter / queue state cannot ride "
-                    "the fused scan carry)"
+                    "(the scaffold/feddyn c_global recursion and the "
+                    "fedbuff/gossip schedulers cannot ride the fused "
+                    "scan carry)"
                 )
-            if (self.server.aggregator != "weighted_mean"
-                    or self.server.secure_aggregation
-                    or self.server.error_feedback):
+            if self.server.secure_aggregation:
+                # the pairwise seed matrix is a per-round host PROTOCOL
+                # output (DH agreement + Shamir recovery of the realized
+                # dropout set, discovered only after uploads) — it
+                # cannot be precomputed into a stacked scan input.
+                # Robust aggregators, upload attacks, and error
+                # feedback all fuse (the delta stack stays private to
+                # the scan body; the EF store rides the scan carry).
                 raise ValueError(
-                    "fuse_rounds > 1 supports the plain weighted-mean "
-                    "path only (no robust aggregation, secagg, or "
-                    "error feedback)"
+                    "fuse_rounds > 1 is incompatible with "
+                    "secure_aggregation (per-round key-protocol host "
+                    "I/O cannot ride the fused scan)"
                 )
             if self.data.placement != "hbm":
                 raise ValueError(
@@ -1044,13 +1062,6 @@ class ExperimentConfig:
                     "label space (model.num_classes >= 2)"
                 )
             if atk.kind in UPLOAD_ATTACKS:
-                if self.run.fuse_rounds > 1:
-                    raise ValueError(
-                        "upload attacks are incompatible with "
-                        "run.fuse_rounds > 1 (the fused scan is the "
-                        "plain-psum path; per-round byzantine masks and "
-                        "delta stacks are per-round inputs)"
-                    )
                 if self.algorithm == "gossip" and atk.kind == "alie":
                     raise ValueError(
                         "attack.kind='alie' is incompatible with "

@@ -71,18 +71,20 @@ def _install_listener() -> None:
 
 
 class _Span:
-    __slots__ = ("_tracer", "_name", "_start")
+    __slots__ = ("_tracer", "_name", "_args", "_start")
 
-    def __init__(self, tracer: "Tracer", name: str):
+    def __init__(self, tracer: "Tracer", name: str, args=None):
         self._tracer = tracer
         self._name = name
+        self._args = args
 
     def __enter__(self):
         self._start = self._tracer._clock()
         return self
 
     def __exit__(self, *exc):
-        self._tracer._record(self._name, self._start, self._tracer._clock())
+        self._tracer._record(self._name, self._start, self._tracer._clock(),
+                             self._args)
         return False
 
 
@@ -112,12 +114,18 @@ class Tracer:
 
     # ------------------------------------------------------------------
 
-    def span(self, name: str):
+    def span(self, name: str, **args):
+        """``args`` annotate the span (e.g. ``span("round.dispatch",
+        fuse=10)``): they ride into the Chrome-trace event's ``args``
+        dict so the timeline shows per-chunk attributes; the per-phase
+        aggregates stay keyed by name only (one stable phase taxonomy
+        regardless of attribute values)."""
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name)
+        return _Span(self, name, args or None)
 
-    def _record(self, name: str, start: float, end: float) -> None:
+    def _record(self, name: str, start: float, end: float,
+                args=None) -> None:
         dur = end - start
         with self._lock:
             agg = self._agg.get(name)
@@ -129,14 +137,17 @@ class Tracer:
                 if dur > agg[2]:
                     agg[2] = dur
             if self.trace:
-                self._events.append({
+                event = {
                     "name": name,
                     "ph": "X",
                     "pid": os.getpid(),
                     "tid": threading.get_ident() & 0xFFFF,
                     "ts": (start - self._t0) * 1e6,  # µs, run-relative
                     "dur": dur * 1e6,
-                })
+                }
+                if args:
+                    event["args"] = args
+                self._events.append(event)
 
     def _note_compile(self, duration: float) -> None:
         with self._lock:

@@ -48,7 +48,13 @@ def initialize(coordinator: Optional[str] = None,
     if already is not None:
         if already():
             return
-    elif getattr(jax.distributed.global_state, "client", None) is not None:
+    elif getattr(
+        getattr(jax.distributed, "global_state", None), "client", None
+    ) is not None:
+        # older jax: no is_initialized(); probe the client directly.
+        # jax builds exposing NEITHER accessor fall through to
+        # initialize() (a repeated call then raises there — loud,
+        # instead of an AttributeError here masking the real state)
         return
     kwargs = {}
     if coordinator is not None:

@@ -73,3 +73,22 @@ def cohort_sharded(mesh: Mesh) -> NamedSharding:
     if has_batch_axis(mesh):
         return NamedSharding(mesh, P(CLIENT_AXIS, None, BATCH_AXIS))
     return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def fused_cohort_sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for the fused [F, K, steps, batch] index/mask slabs
+    (run.fuse_rounds > 1): the leading fuse dim is replicated (every
+    lane scans all F rounds), the cohort dim shards over lanes exactly
+    like :func:`cohort_sharded`. Placing the stacked slabs through this
+    sharding (instead of host-side jnp.stack of per-round arrays) is
+    what makes the fused path multi-process capable: each host uploads
+    only its addressable shards via ``host_local_array``."""
+    if has_batch_axis(mesh):
+        return NamedSharding(mesh, P(None, CLIENT_AXIS, None, BATCH_AXIS))
+    return NamedSharding(mesh, P(None, CLIENT_AXIS))
+
+
+def fused_client_sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for fused [F, K] per-client vectors (n_ex, byzantine
+    masks): fuse dim replicated, cohort dim over lanes."""
+    return NamedSharding(mesh, P(None, CLIENT_AXIS))

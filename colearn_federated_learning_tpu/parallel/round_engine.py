@@ -654,15 +654,19 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     # per-client store (stateful algorithms carry c_global + the dc psum
     # on top of it; error feedback only the store itself)
     use_store = stateful or error_feedback
-    if fuse_rounds > 1 and (
-        stateful or error_feedback or secagg
-        or aggregator != "weighted_mean"
-    ):
-        # the fused scan carries only (params, opt); per-round store
-        # scatters, seed-matrix inputs and per-client delta stacks are
-        # per-round host I/O (mirrors config.validate)
+    if fuse_rounds > 1 and (stateful or secagg):
+        # scaffold/feddyn's c_global recursion is rejected by
+        # config.validate (algorithm pairing); secagg's pairwise seed
+        # matrices are per-round host PROTOCOL outputs (DH agreement +
+        # Shamir recovery of the realized dropout set) that cannot be
+        # precomputed into a stacked scan input. Robust aggregators,
+        # upload attacks, and error feedback all fuse: the per-client
+        # delta stack stays private to the scan body, byzantine masks
+        # become [fuse, K] scan inputs, and the EF store rides the scan
+        # carry (mirrors config.validate).
         raise ValueError(
-            "fuse_rounds > 1 supports the plain weighted-mean path only"
+            "fuse_rounds > 1 is incompatible with stateful algorithms "
+            "and secure aggregation"
         )
     if use_store and num_clients <= 0:
         raise ValueError("per-client state requires num_clients")
@@ -673,12 +677,6 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     # and alie's cohort statistics — act on individual uploads), so the
     # lane emits it exactly as the robust aggregators do
     emit_stack = robust or bool(attack)
-    if attack and fuse_rounds > 1:
-        raise ValueError(
-            "attack simulation is incompatible with fuse_rounds > 1 "
-            "(per-round byzantine masks / delta stacks are per-round "
-            "inputs)"
-        )
     use_decay = client_cfg.lr_decay != 1.0
     from colearn_federated_learning_tpu.ops.compression import (
         downlink_quantize,
@@ -1140,9 +1138,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
     if error_feedback:
 
-        @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
-        def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
-                     n_ex, rng, e_clients, cohort):
+        def _ef_check(e_clients):
             n_lanes_ = mesh.shape[CLIENT_AXIS]
             for leaf in jax.tree.leaves(e_clients):
                 if leaf.shape[0] % n_lanes_:
@@ -1152,6 +1148,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         f"store; pad rows are never addressed)"
                     )
                 break
+
+        def _ef_one_round(params, server_opt_state, train_x, train_y, idx,
+                          mask, n_ex, rng, e_clients, cohort):
             keys = _cohort_keys(rng, idx.shape[0])
             extra = ()
             if use_decay:
@@ -1167,6 +1166,40 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )
             return (new_params, new_opt_state, out["c_all"],
                     RoundMetrics(out["loss"], out["n"]))
+
+        if fuse_rounds > 1:
+            # fused EF: the device-resident [N_pad, ...] residual store
+            # is a DONATED scan carry — the in-program scatter updates
+            # it each fused sub-round with zero host involvement, and
+            # the store buffer is reused across the whole chunk
+
+            @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
+            def round_fn(params, server_opt_state, train_x, train_y, idx_f,
+                         mask_f, n_ex_f, rngs, e_clients, cohorts):
+                _ef_check(e_clients)
+
+                def body(carry, inp):
+                    p, o, e = carry
+                    i, m, n, r, coh = inp
+                    p, o, e, met = _ef_one_round(
+                        p, o, train_x, train_y, i, m, n, r, e, coh
+                    )
+                    return (p, o, e), met
+
+                (p, o, e), ms = jax.lax.scan(
+                    body, (params, server_opt_state, e_clients),
+                    (idx_f, mask_f, n_ex_f, rngs, cohorts),
+                )
+                return p, o, e, ms  # RoundMetrics with [F]-stacked fields
+
+            return round_fn
+
+        @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
+        def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
+                     n_ex, rng, e_clients, cohort):
+            _ef_check(e_clients)
+            return _ef_one_round(params, server_opt_state, train_x, train_y,
+                                 idx, mask, n_ex, rng, e_clients, cohort)
 
         return round_fn
 
@@ -1239,27 +1272,42 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
 
     if fuse_rounds > 1:
-        # Multi-round fusion (r5, VERDICT r4 weak-#2): F rounds as ONE
-        # XLA program — a lax.scan over the per-round body with stacked
-        # [F, ...] index tensors and the SAME per-round rngs the
-        # unfused loop derives, so fused ≡ unfused bitwise (test-pinned)
-        # while the per-round dispatch cost (the dominant cost of the
-        # tiny-model configs on a relayed chip) is paid once per F.
-        # Restricted by config.validate to the plain weighted-mean path.
+        # Multi-round fusion (r5, VERDICT r4 weak-#2; generalized r6):
+        # F rounds as ONE XLA program — a lax.scan over the per-round
+        # body with stacked [F, ...] index tensors and the SAME
+        # per-round rngs the unfused loop derives, so fused ≡ unfused
+        # bitwise (test-pinned) while the per-round dispatch cost (the
+        # dominant cost of the tiny-model configs on a relayed chip) is
+        # paid once per F. Robust aggregators and upload attacks fuse
+        # too: _one_round's per-client delta stack (and the attack
+        # transform / coordinate-wise sort over it) stays PRIVATE to
+        # the scan body — only the [F]-stacked scalar metrics leave the
+        # program — and the per-round byzantine masks ride a stacked
+        # [F, K] scan input alongside n_ex_f.
 
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx_f,
-                     mask_f, n_ex_f, rngs):
+                     mask_f, n_ex_f, rngs, byz_f=None):
+            if attack and byz_f is None:
+                raise TypeError(
+                    f"attack={attack!r} requires the stacked [fuse, K] "
+                    f"byz mask input"
+                )
+
             def body(carry, inp):
                 p, o = carry
-                i, m, n, r = inp
-                p, o, met = _one_round(p, o, train_x, train_y, i, m, n, r)
+                if attack:
+                    i, m, n, r, bz = inp
+                else:
+                    (i, m, n, r), bz = inp, None
+                p, o, met = _one_round(p, o, train_x, train_y, i, m, n, r,
+                                       bz)
                 return (p, o), met
 
-            (p, o), ms = jax.lax.scan(
-                body, (params, server_opt_state),
-                (idx_f, mask_f, n_ex_f, rngs),
-            )
+            xs = (idx_f, mask_f, n_ex_f, rngs)
+            if attack:
+                xs += (byz_f,)
+            (p, o), ms = jax.lax.scan(body, (params, server_opt_state), xs)
             return p, o, ms  # RoundMetrics with [F]-stacked fields
 
         return round_fn

@@ -166,6 +166,10 @@ class Experiment:
         self._param_stats_cache = None
         self._comm_stats: Dict[int, Dict[str, int]] = {}
         self._fail_stats: Dict[int, Dict[str, int]] = {}
+        # unfused engine twin for non-chunk-aligned resumes under
+        # run.fuse_rounds > 1 (set below for the sharded sync path)
+        self._make_engine = None
+        self._unfused_cache = None
         # Byzantine adversary simulation (AttackConfig, server/attacks.py):
         # the compromised id set is a deterministic pure function of
         # (run.seed, num_clients, fraction) — fixed for the whole run,
@@ -267,38 +271,49 @@ class Experiment:
                     scan_unroll=cfg.run.scan_unroll,
                 )
             else:
-                self.round_fn = make_sharded_round_fn(
-                    self.model, cfg.client, cfg.dp, self.task, self.mesh,
-                    server_update,
-                    self._poisson_cap or cfg.server.cohort_size,
-                    dp_fixed_denom=cfg.server.cohort_size,
-                    client_vmap_width=cfg.run.client_vmap_width,
-                    local_dtype=self._local_dtype(), agg=agg,
-                    scaffold=self.scaffold, num_clients=self.fed.num_clients,
-                    aggregator=cfg.server.aggregator,
-                    trim_ratio=cfg.server.trim_ratio,
-                    compression=cfg.server.compression,
-                    topk_ratio=cfg.server.compression_topk_ratio,
-                    qsgd_levels=cfg.server.compression_qsgd_levels,
-                    topk_exact=cfg.server.compression_topk_exact,
-                    clip_delta_norm=cfg.server.clip_delta_norm,
-                    feddyn_alpha=(
-                        cfg.server.feddyn_alpha if self.feddyn else 0.0
-                    ),
-                    byzantine_f=cfg.server.krum_byzantine,
-                    scan_unroll=cfg.run.scan_unroll,
-                    secagg=self.secagg,
-                    secagg_quant_step=cfg.server.secagg_quant_step,
-                    secagg_mode=cfg.server.secagg_mode,
-                    client_dp_noise=cfg.server.dp_client_noise_multiplier,
-                    downlink=cfg.server.downlink_compression,
-                    downlink_levels=cfg.server.downlink_qsgd_levels,
-                    error_feedback=self.ef,
-                    fuse_rounds=cfg.run.fuse_rounds,
-                    attack=self.attack_kind if self._attack_upload else "",
-                    attack_scale=cfg.attack.scale,
-                    attack_eps=cfg.attack.eps,
-                )
+                def _make_engine(fuse):
+                    return make_sharded_round_fn(
+                        self.model, cfg.client, cfg.dp, self.task, self.mesh,
+                        server_update,
+                        self._poisson_cap or cfg.server.cohort_size,
+                        dp_fixed_denom=cfg.server.cohort_size,
+                        client_vmap_width=cfg.run.client_vmap_width,
+                        local_dtype=self._local_dtype(), agg=agg,
+                        scaffold=self.scaffold,
+                        num_clients=self.fed.num_clients,
+                        aggregator=cfg.server.aggregator,
+                        trim_ratio=cfg.server.trim_ratio,
+                        compression=cfg.server.compression,
+                        topk_ratio=cfg.server.compression_topk_ratio,
+                        qsgd_levels=cfg.server.compression_qsgd_levels,
+                        topk_exact=cfg.server.compression_topk_exact,
+                        clip_delta_norm=cfg.server.clip_delta_norm,
+                        feddyn_alpha=(
+                            cfg.server.feddyn_alpha if self.feddyn else 0.0
+                        ),
+                        byzantine_f=cfg.server.krum_byzantine,
+                        scan_unroll=cfg.run.scan_unroll,
+                        secagg=self.secagg,
+                        secagg_quant_step=cfg.server.secagg_quant_step,
+                        secagg_mode=cfg.server.secagg_mode,
+                        client_dp_noise=cfg.server.dp_client_noise_multiplier,
+                        downlink=cfg.server.downlink_compression,
+                        downlink_levels=cfg.server.downlink_qsgd_levels,
+                        error_feedback=self.ef,
+                        fuse_rounds=fuse,
+                        attack=(
+                            self.attack_kind if self._attack_upload else ""
+                        ),
+                        attack_scale=cfg.attack.scale,
+                        attack_eps=cfg.attack.eps,
+                    )
+
+                self.round_fn = _make_engine(cfg.run.fuse_rounds)
+                # an unfused twin is built lazily (one extra compile)
+                # only when a resume lands off a chunk boundary — see
+                # _unfused_round_fn / the _fit_body catch-up loop
+                if cfg.run.fuse_rounds > 1:
+                    self._make_engine = _make_engine
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
             self._client_sharding = mesh_lib.client_sharded(self.mesh)
@@ -360,16 +375,21 @@ class Experiment:
         put = self._put_data
         self._stream = cfg.data.placement == "stream"
         self._check_memory_budget()
-        if cfg.run.fuse_rounds > 1 and jax.process_count() > 1:
-            # the fused branch stacks cohort-sharded GLOBAL arrays
-            # host-side (jnp.stack), which multi-process runs cannot
-            # address; config.validate cannot see the process count, so
-            # guard here (the store_state precedent above)
-            raise NotImplementedError(
-                "run.fuse_rounds > 1 is single-process only (the fused "
-                "input stacking is host-side); set fuse_rounds=1 under "
-                "multi-host"
+        # Fused-chunk placement (run.fuse_rounds > 1): the stacked
+        # [F, K, ...] host slabs go through the same _put path as the
+        # per-round tensors, with the fuse dim replicated — under
+        # multi-process each host uploads only its addressable shards
+        # (host_local_array), so fusion composes with multi-host meshes.
+        if self.mesh is not None:
+            self._fused_cohort_sharding = mesh_lib.fused_cohort_sharded(
+                self.mesh
             )
+            self._fused_client_sharding = mesh_lib.fused_client_sharded(
+                self.mesh
+            )
+        else:
+            self._fused_cohort_sharding = None
+            self._fused_client_sharding = None
         self._prefetch: Dict[int, Any] = {}
         self._host_executor = None
         if self._stream:
@@ -826,10 +846,16 @@ class Experiment:
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
         if self._native is not None:
             self._native.submit(round_idx, cohort)  # no-op if prefetched
-            if round_idx + 1 < self.cfg.server.num_rounds:
-                # overlap: round r+1's tensors build on C++ worker threads
-                # while the device executes round r
-                self._native.submit(round_idx + 1, self.sampler.sample(round_idx + 1))
+            # overlap: the NEXT dispatch's tensors build on C++ worker
+            # threads while the device executes this one. Under
+            # run.fuse_rounds > 1 a dispatch consumes a whole chunk, so
+            # the look-ahead window is `fuse` rounds of index slabs per
+            # submit (duplicate submits are no-ops in the pipeline).
+            ahead = max(1, self.cfg.run.fuse_rounds)
+            for j in range(1, ahead + 1):
+                nxt = round_idx + j
+                if nxt < self.cfg.server.num_rounds:
+                    self._native.submit(nxt, self.sampler.sample(nxt))
             idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
@@ -913,7 +939,12 @@ class Experiment:
             }
         return mask, n_ex
 
-    def _round_inputs(self, round_idx: int):
+    def _round_inputs(self, round_idx: int, place: bool = True):
+        """``place=False`` returns the idx/mask/n_ex tensors as HOST
+        arrays (the fused-chunk path stacks `fuse` rounds of them and
+        places the [F, ...] slabs once through the fused shardings —
+        stacking already-placed global arrays would be an eager op on
+        non-addressable shards under multi-process)."""
         fut = self._prefetch.pop(round_idx, None)
         # the span measures the CRITICAL-PATH host-input cost: ~0 when
         # the prefetch worker ran ahead, the full build otherwise
@@ -936,6 +967,9 @@ class Experiment:
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._counters_on:
             self._comm_stats[round_idx] = self._round_comm(cohort, n_host)
+        if not place:
+            # fuse>1 requires hbm placement (validate), so slab is None
+            return cohort, idx, mask, n_ex, self.train_x, self.train_y, n_host
         with self.tracer.span("round.placement"):
             if slab is not None:
                 idx, slab_x, slab_y = slab
@@ -1110,9 +1144,34 @@ class Experiment:
             arr = self._put(arr, self._data_sharding)
         return arr
 
-    def run_round(self, state: Dict[str, Any], round_idx: int) -> Dict[str, Any]:
+    def _unfused_round_fn(self):
+        """The fuse_rounds=1 engine twin, built lazily (one extra
+        compile) the first time a non-chunk-aligned resume needs
+        unfused catch-up rounds."""
+        if self._unfused_cache is None:
+            if self._make_engine is None:
+                raise RuntimeError(
+                    "no unfused engine twin for this configuration"
+                )
+            self._unfused_cache = self._make_engine(1)
+        return self._unfused_cache
+
+    def run_round(self, state: Dict[str, Any], round_idx: int,
+                  fuse_override: Optional[int] = None) -> Dict[str, Any]:
+        """``fuse_override=1`` forces a single unfused round through the
+        lazily-built fuse=1 engine twin — the catch-up path for resumes
+        that land off a chunk boundary (see _fit_body)."""
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
+        fuse = (
+            self.cfg.run.fuse_rounds if fuse_override is None
+            else fuse_override
+        )
+        if fuse > 1:
+            return self._run_fused_chunk(state, round_idx, fuse)
+        round_fn = self.round_fn
+        if self.cfg.run.fuse_rounds > 1:
+            round_fn = self._unfused_round_fn()
         (cohort, idx, mask, n_ex, train_x, train_y,
          n_host) = self._round_inputs(round_idx)
         rng = jax.random.fold_in(state["rng_key"], round_idx)
@@ -1138,7 +1197,7 @@ class Experiment:
                     self._data_sharding,
                 ),)
             with self.tracer.span("round.dispatch"):
-                replicas, mean_params, metrics = self.round_fn(
+                replicas, mean_params, metrics = round_fn(
                     state["replicas"], train_x, train_y, idx, mask, n_ex,
                     rng, *extra, **akw,
                 )
@@ -1167,7 +1226,7 @@ class Experiment:
                     self._data_sharding,
                 )
                 with self.tracer.span("round.dispatch"):
-                    out = self.round_fn(
+                    out = round_fn(
                         *common, *glob, state["c_clients"], cohort_dev,
                     )
                 *head, c_clients, metrics = out
@@ -1185,7 +1244,7 @@ class Experiment:
                     lambda a: jnp.asarray(a[safe]), state["c_clients"]
                 )
                 with self.tracer.span("round.dispatch"):
-                    out = self.round_fn(
+                    out = round_fn(
                         *common, *(glob or (None,)), c_cohort,
                     )
                 *head, new_c_cohort, metrics = out
@@ -1208,46 +1267,12 @@ class Experiment:
             if self.stateful:
                 new_state["c_global"] = head[2]
             return new_state
-        fuse = self.cfg.run.fuse_rounds
-        if fuse > 1:
-            # stack this chunk's rounds (round_idx is chunk-aligned by
-            # the fit loop); per-round rngs are EXACTLY the unfused
-            # loop's derivations, so fused ≡ unfused bitwise
-            chunks = [(idx, mask, n_ex)]
-            rngs = [rng]
-            for j in range(1, fuse):
-                (c_j, i_j, m_j, n_j, tx_j, ty_j,
-                 _) = self._round_inputs(round_idx + j)
-                chunks.append((i_j, m_j, n_j))
-                rngs.append(jax.random.fold_in(state["rng_key"],
-                                               round_idx + j))
-                if self.attack_kind:
-                    # label_flip composes with fusion (data-level only);
-                    # keep byzantine_count per fused sub-round
-                    self._attack_stats[round_idx + j] = int(
-                        np.isin(np.asarray(c_j), self.compromised).sum()
-                    )
-            stack = lambda xs: jnp.stack([jnp.asarray(x) for x in xs])  # noqa: E731
-            with self.tracer.span("round.dispatch"):
-                params, opt_state, metrics = self.round_fn(
-                    state["params"], state["server_opt_state"], train_x,
-                    train_y, stack([c[0] for c in chunks]),
-                    stack([c[1] for c in chunks]),
-                    stack([c[2] for c in chunks]), jnp.stack(rngs),
-                )
-            return {
-                "params": params,
-                "server_opt_state": opt_state,
-                "round": round_idx + fuse,
-                "rng_key": state["rng_key"],
-                "_metrics": metrics,
-            }
         kw = dict(akw)
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
             with self.tracer.span("round.secagg_keys"):
                 kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
         with self.tracer.span("round.dispatch"):
-            params, opt_state, metrics = self.round_fn(
+            params, opt_state, metrics = round_fn(
                 state["params"], state["server_opt_state"],
                 train_x, train_y, idx, mask, n_ex, rng, **kw,
             )
@@ -1258,6 +1283,83 @@ class Experiment:
             "rng_key": state["rng_key"],
             "_metrics": metrics,
         }
+
+    def _run_fused_chunk(self, state: Dict[str, Any], round_idx: int,
+                         fuse: int) -> Dict[str, Any]:
+        """Dispatch one fused chunk: `fuse` rounds as ONE XLA program.
+
+        The chunk's host inputs are built per sub-round (exactly the
+        unfused loop's tensors, prefetch included), stacked host-side
+        into [F, ...] slabs, and placed ONCE through the fused
+        shardings — the multi-process-capable path (each host uploads
+        only its addressable shards). Per-round rngs are the unfused
+        loop's exact derivations, so fused ≡ unfused bitwise. Upload
+        attacks ride a stacked [F, K] byzantine-mask input; error
+        feedback's store enters as the donated scan carry and comes
+        back updated in place."""
+        idxs, masks, n_exs, rngs, cohorts, byz_rows = [], [], [], [], [], []
+        train_x = train_y = None
+        for j in range(fuse):
+            (c_j, i_j, m_j, n_j, train_x, train_y,
+             _) = self._round_inputs(round_idx + j, place=False)
+            idxs.append(i_j)
+            masks.append(m_j)
+            n_exs.append(n_j)
+            cohorts.append(np.asarray(c_j, np.int32))
+            rngs.append(jax.random.fold_in(state["rng_key"], round_idx + j))
+            if self.attack_kind:
+                # byzantine_count per fused sub-round, for every attack
+                # kind (label_flip attacks through data and composes
+                # with fusion with no engine involvement)
+                byz_h = np.isin(np.asarray(c_j), self.compromised)
+                self._attack_stats[round_idx + j] = int(byz_h.sum())
+                if self._attack_upload:
+                    byz_rows.append(byz_h.astype(np.float32))
+        with self.tracer.span("round.placement"):
+            idx_f = self._put(np.stack(idxs), self._fused_cohort_sharding)
+            mask_f = self._put(np.stack(masks), self._fused_cohort_sharding)
+            n_ex_f = self._put(np.stack(n_exs), self._fused_client_sharding)
+            # rng keys are tiny device scalars derived identically on
+            # every process; stack on host (normalizing typed PRNG keys
+            # — a restored checkpoint's rng_key comes back typed — to
+            # their raw uint32 data, which fold_in/split accept with
+            # identical bits), replicate like other per-round inputs
+            def _key_data(k):
+                if jax.dtypes.issubdtype(k.dtype, jax.dtypes.prng_key):
+                    k = jax.random.key_data(k)
+                return np.asarray(k)
+
+            rngs_f = self._put(
+                np.stack([_key_data(r) for r in rngs]), self._data_sharding
+            )
+            tail = ()
+            if byz_rows:
+                tail = (self._put(
+                    np.stack(byz_rows), self._fused_client_sharding
+                ),)
+            if self.ef:
+                cohorts_f = self._put(
+                    np.stack(cohorts), self._data_sharding
+                )
+        common = (state["params"], state["server_opt_state"], train_x,
+                  train_y, idx_f, mask_f, n_ex_f, rngs_f)
+        with self.tracer.span("round.dispatch", fuse=fuse):
+            if self.ef:
+                params, opt_state, c_clients, metrics = self.round_fn(
+                    *common, state["c_clients"], cohorts_f,
+                )
+            else:
+                params, opt_state, metrics = self.round_fn(*common, *tail)
+        new_state = {
+            "params": params,
+            "server_opt_state": opt_state,
+            "round": round_idx + fuse,
+            "rng_key": state["rng_key"],
+            "_metrics": metrics,
+        }
+        if self.ef:
+            new_state["c_clients"] = c_clients
+        return new_state
 
     # ------------------------------------------------------------------
 
@@ -1615,18 +1717,35 @@ class Experiment:
             flush_t0 = time.perf_counter()
 
         fuse = cfg.run.fuse_rounds if not (
-            self.fedbuff or self.gossip or self.store_state
+            self.fedbuff or self.gossip or self.stateful
         ) else 1
         if fuse > 1 and start_round % fuse:
-            # a warm-start/checkpoint at an unaligned round would shift
+            # A warm-start/checkpoint at an unaligned round would shift
             # every chunk boundary: evals/saves (validated as fuse
             # multiples) would never fire and the last chunk would run
-            # past num_rounds — refuse instead of silently misbehaving
-            raise ValueError(
-                f"resume/warm-start round {start_round} is not a "
-                f"fuse_rounds={fuse} chunk boundary; set fuse_rounds=1 "
-                f"for this run or resume from an aligned checkpoint"
-            )
+            # past num_rounds. Instead of refusing, run UNFUSED rounds
+            # (through the lazily-built fuse=1 engine twin) up to the
+            # next chunk boundary, then re-enter the fused loop on the
+            # re-aligned schedule.
+            aligned = min(-(-start_round // fuse) * fuse,
+                          cfg.server.num_rounds)
+            self.logger.log({
+                "event": "warning",
+                "warning": "fuse_unaligned_resume",
+                "round": start_round,
+                "detail": (
+                    f"resume/warm-start round {start_round} is not a "
+                    f"fuse_rounds={fuse} chunk boundary; running "
+                    f"{aligned - start_round} unfused catch-up round(s) "
+                    f"to round {aligned}, then re-entering the fused loop"
+                ),
+            })
+            for r in range(start_round, aligned):
+                with self.tracer.span("round"):
+                    state = self.run_round(state, r, fuse_override=1)
+                pending.append((r, state.pop("_metrics")))
+            flush(state)
+            start_round = aligned
         for r in range(start_round, cfg.server.num_rounds, fuse):
             profiling = r == cfg.run.profile_round
             if profiling:

@@ -63,8 +63,10 @@ accuracy band. Defenses act per round on the upload stack, so they do
 NOT defend `label_flip` (an honest-looking gradient of poisoned data) —
 that is the attack's point. Unsound pairings (secure aggregation,
 client-level or example-level DP, scaffold/feddyn, fedbuff,
-error feedback, fused rounds under upload attacks) are rejected by
-`validate()` with reasons.
+error feedback) are rejected by `validate()` with reasons. Upload
+attacks compose with `run.fuse_rounds > 1`: the per-round byzantine
+masks become a stacked `[fuse, K]` scan input and the attacked delta
+stack stays private to the fused scan body.
 """
 
 

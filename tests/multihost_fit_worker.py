@@ -30,6 +30,12 @@ Modes (5th arg, default ``fedavg``):
   Shamir-recovered dropped rows) is a replicated host input; the
   per-pair mask scan's int32 cancellation survives the cross-process
   psum.
+- ``fused``    — r6 multi-round fusion under multi-process: the stacked
+  ``[F, K, ...]`` host slabs place through the fused shardings
+  (``host_local_array`` — each process uploads only its addressable
+  shards) and one dispatch executes fuse=2 rounds; combined with a
+  robust aggregator so the in-scan delta stack crosses the process
+  boundary too.
 
 Run: multihost_fit_worker.py <pid> <nprocs> <port> <out_dir> [mode].
 """
@@ -107,6 +113,12 @@ def main():
             cfg.data.num_clients = 16
             cfg.server.sampling = "poisson"
             cfg.server.dropout_rate = 0.2
+        elif mode == "fused":
+            # fuse=2 divides rounds (4, 6), eval_every and
+            # checkpoint_every (2); median exercises the in-scan
+            # per-client delta stack across the process boundary
+            cfg.run.fuse_rounds = 2
+            cfg.server.aggregator = "median"
         elif mode == "pairwise":
             # r5: pairwise-secagg seed matrix is a replicated host
             # input (deterministic per round) — the mask scan and the

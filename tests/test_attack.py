@@ -248,11 +248,6 @@ def _attack_cfg(kind="sign_flip", **over):
     ("gauss",
      {"server.error_feedback": True, "server.compression": "qsgd"},
      "error_feedback"),
-    ("alie",
-     {"data.num_clients": 8, "server.cohort_size": 4,
-      "server.num_rounds": 8, "server.eval_every": 4,
-      "run.fuse_rounds": 4},
-     "fuse_rounds"),
     ("label_flip", {"model.num_classes": 0}, "num_classes"),
 ])
 def test_attack_pairing_rejections(kind, overrides, match):
@@ -279,6 +274,20 @@ def test_label_flip_composes_with_fused_rounds():
     cfg.server.eval_every = 4
     cfg.run.fuse_rounds = 4
     cfg.validate()  # data-level attack, no engine involvement
+
+
+def test_upload_attacks_compose_with_fused_rounds():
+    """r6: upload attacks validate under fuse_rounds > 1 (the byzantine
+    masks become a stacked [fuse, K] scan input); the fused↔unfused
+    numeric parity is pinned in tests/test_round_engine.py."""
+    for kind in UPLOAD_ATTACKS:
+        cfg = _attack_cfg(kind)
+        cfg.data.num_clients = 8
+        cfg.server.cohort_size = 4
+        cfg.server.num_rounds = 8
+        cfg.server.eval_every = 4
+        cfg.run.fuse_rounds = 4
+        cfg.validate()
 
 
 def test_engine_rejects_unsound_attack_combinations():

@@ -100,6 +100,28 @@ def test_weighting_bug_trips_band(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+def test_bf16_local_param_path_converges(tmp_path):
+    """The headline config ships run.local_param_dtype=bfloat16 (the
+    per-step f32→bf16 cast removal, ~17% of round time on v5e —
+    config.py RunConfig docs), but the band test above pins the pure-f32
+    path. Guard the SHIPPED dtype stack too: bf16 compute + bf16 local
+    params over the same reduced task must stay in the f32 band (floor
+    relaxed 0.05 for bf16 rounding drift) — a regression that only
+    bites the mixed-precision local path (e.g. a cast placed inside the
+    step loop) lands here."""
+    cfg = _reduced_cfg(tmp_path)
+    cfg.apply_overrides({
+        "run.compute_dtype": "bfloat16",
+        "run.local_param_dtype": "bfloat16",
+    })
+    exp = Experiment(cfg.validate(), echo=False)
+    state = exp.fit()
+    ev = exp.evaluate(state["params"])
+    assert math.isfinite(ev["eval_loss"])
+    assert 0.80 <= ev["eval_acc"] <= 0.99, ev
+
+
+@pytest.mark.slow
 def test_cifar10_fedavg_1000_converges(tmp_path):
     """North-star-scale learning regression: the FULL 1000-client
     federation (cohort 64 shrunk to 16 for CPU budget, model narrowed)

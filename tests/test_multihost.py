@@ -253,6 +253,26 @@ def test_two_process_ef_fit(tmp_path):
     assert parsed[0][2:] == parsed[1][2:], parsed
 
 
+def test_two_process_fused_fit(tmp_path):
+    """Round fusion under multi-process (r6): the stacked [F, K, ...]
+    round-input slabs place through the fused shardings via
+    host_local_array, one dispatch executes fuse=2 rounds, and the
+    robust aggregator's in-scan delta stack crosses the process
+    boundary; fit + collective checkpoint/resume complete with
+    identical final params on both hosts."""
+    outs = _run_workers(
+        _FIT_WORKER, extra_args=(str(tmp_path / "runs"), "fused"),
+        timeout=600,
+    )
+    parsed = _parse(
+        outs,
+        r"MULTIHOST_FIT_OK pid=(\d) round=(\d+) acc=([\d.]+) "
+        r"loss=([\d.]+) leaf0=(-?[\d.]+)",
+    )
+    assert parsed[0][1] == parsed[1][1] == "6", parsed
+    assert parsed[0][2:] == parsed[1][2:], parsed
+
+
 def test_four_process_fit(tmp_path):
     """Scale the multiplicity: the SAME 8-device mesh split over FOUR
     processes (2 devices each). Every process completes fit + resume

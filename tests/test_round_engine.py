@@ -303,7 +303,7 @@ class TestFusedRounds:
     """run.fuse_rounds=F: F rounds as one XLA program (lax.scan over
     the round body with the unfused loop's EXACT per-round rngs)."""
 
-    def _run(self, fuse, rounds=6):
+    def _run(self, fuse, rounds=6, **over):
         from colearn_federated_learning_tpu.config import get_named_config
         from colearn_federated_learning_tpu.server.round_driver import (
             Experiment,
@@ -319,6 +319,9 @@ class TestFusedRounds:
         cfg.run.fuse_rounds = fuse
         cfg.data.synthetic_train_size = 256
         cfg.data.synthetic_test_size = 64
+        for k, v in over.items():
+            cfg.apply_overrides({k: v})
+        cfg.validate()
         exp = Experiment(cfg, echo=False)
         state = exp.fit()
         return state, exp
@@ -333,6 +336,109 @@ class TestFusedRounds:
                 np.asarray(x), np.asarray(y)),
             a["params"], b["params"],
         )
+
+    # the generalized fused scan (r6): every robust aggregator, with
+    # and without a live upload attack, must reproduce the unfused
+    # loop exactly — the per-client delta stack stays private to the
+    # scan body, the byzantine masks ride a stacked [fuse, K] input
+    @pytest.mark.parametrize("aggregator", [
+        "weighted_mean", "median", "trimmed_mean", "krum",
+    ])
+    @pytest.mark.parametrize("attack", ["", "sign_flip"])
+    def test_fused_robust_and_attacked_parity(self, aggregator, attack):
+        over = {"server.aggregator": aggregator}
+        if attack:
+            over.update({"attack.kind": attack, "attack.fraction": 0.25})
+        a, _ = self._run(1, rounds=4, **over)
+        b, _ = self._run(2, rounds=4, **over)
+        assert int(a["round"]) == int(b["round"]) == 4
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            a["params"], b["params"],
+        )
+
+    def test_fused_error_feedback_carry_parity(self):
+        """EF under fusion: the residual store rides the scan carry —
+        params AND the post-run store must match the unfused loop."""
+        over = {"server.compression": "qsgd",
+                "server.error_feedback": True}
+        a, _ = self._run(1, rounds=4, **over)
+        b, _ = self._run(2, rounds=4, **over)
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            a["params"], b["params"],
+        )
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            a["c_clients"], b["c_clients"],
+        )
+
+    def test_unaligned_resume_runs_unfused_catchup(self, tmp_path):
+        """A checkpoint at a non-chunk-aligned round no longer errors:
+        the driver runs unfused rounds to the next boundary (logging a
+        fuse_unaligned_resume warning), re-enters the fused loop, and
+        the final params match a straight unfused run bitwise."""
+        from colearn_federated_learning_tpu.config import get_named_config
+        from colearn_federated_learning_tpu.server.round_driver import (
+            Experiment,
+        )
+
+        def cfg_for(rounds, resume, fuse, out, ckpt):
+            cfg = get_named_config("mnist_fedavg_2")
+            cfg.data.num_clients = 8
+            cfg.server.cohort_size = 4
+            cfg.server.num_rounds = rounds
+            cfg.server.eval_every = 0
+            cfg.server.checkpoint_every = ckpt
+            cfg.run.out_dir = out
+            cfg.run.resume = resume
+            cfg.run.fuse_rounds = fuse
+            cfg.run.metrics_flush_every = 1
+            cfg.data.synthetic_train_size = 256
+            cfg.data.synthetic_test_size = 64
+            return cfg.validate()
+
+        # 3 unfused rounds with per-round checkpoints: the latest
+        # checkpoint (round 3) is NOT a fuse=2 chunk boundary
+        Experiment(cfg_for(3, False, 1, str(tmp_path), 1), echo=False).fit()
+        exp = Experiment(cfg_for(6, True, 2, str(tmp_path), 2), echo=False)
+        resumed = exp.fit()
+        assert int(resumed["round"]) == 6
+        warns = [r for r in exp.logger.history
+                 if r.get("warning") == "fuse_unaligned_resume"]
+        assert len(warns) == 1 and "1 unfused catch-up" in warns[0]["detail"]
+        # per-round metrics cover the catch-up round AND the fused tail
+        rounds = [r["round"] for r in exp.logger.history
+                  if "train_loss" in r]
+        assert rounds == [4, 5, 6]
+        straight = Experiment(
+            cfg_for(6, False, 1, str(tmp_path / "straight"), 0), echo=False
+        ).fit()
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)),
+            straight["params"], resumed["params"],
+        )
+
+    def test_fuse_smoke_robust_attack(self):
+        """Tier-1 CPU smoke for the generalized fused path (fuse=2,
+        robust aggregator + live attack): the fused program must build,
+        run, and report per-round metrics — a collection-time or
+        trace-time regression in the fused scan fails here fast."""
+        state, exp = self._run(
+            2, rounds=4,
+            **{"server.aggregator": "median",
+               "attack.kind": "sign_flip", "attack.fraction": 0.25},
+        )
+        assert int(state["round"]) == 4
+        rounds = [r for r in exp.logger.history if "train_loss" in r]
+        assert len(rounds) == 4
+        assert all(np.isfinite(r["train_loss"]) for r in rounds)
+        # byzantine_count is attributed per fused sub-round
+        assert all("byzantine_count" in r for r in rounds)
 
     def test_per_round_metrics_preserved(self):
         _, exp = self._run(3)
@@ -372,5 +478,21 @@ class TestFusedRounds:
         cfg.server.eval_every = 2
         cfg.server.secure_aggregation = True
         cfg.server.clip_delta_norm = 1.0
-        with pytest.raises(ValueError, match="plain weighted-mean"):
+        with pytest.raises(ValueError, match="secure_aggregation"):
             cfg.validate()
+        # the r6 generalization: robust aggregators, upload attacks and
+        # error feedback VALIDATE with fuse_rounds > 1 now
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.fuse_rounds = 2
+        cfg.server.num_rounds = 4
+        cfg.server.eval_every = 2
+        cfg.server.aggregator = "median"
+        cfg.attack.kind = "sign_flip"
+        cfg.validate()
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.fuse_rounds = 2
+        cfg.server.num_rounds = 4
+        cfg.server.eval_every = 2
+        cfg.server.compression = "qsgd"
+        cfg.server.error_feedback = True
+        cfg.validate()
