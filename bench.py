@@ -88,7 +88,12 @@ _SHAPES = {
     # plain configs take the dispatch amortization directly (warmup and
     # timed are fused-chunk multiples; fuse divides num_rounds)
     "cifar10_fedavg_1000": (4, 8, {"run.fuse_rounds": 4}),
-    "femnist_fedprox_500": (4, 8, {"run.fuse_rounds": 4}),
+    # r7: femnist's natural-partition (power-law) client sizes make the
+    # federation-max pad mostly dead steps for the median cohort —
+    # shape buckets trim them per chunk (bitwise-equal; the grid is
+    # recorded in extra.shape_bucket_steps so the number stays honest)
+    "femnist_fedprox_500": (4, 8, {"run.fuse_rounds": 4,
+                                   "run.shape_buckets.enabled": True}),
     # shakespeare runs fused via its named config (run.fuse_rounds=10)
     "shakespeare_fedavg": (10, 20, {}),
     "imagenet_silo_dp": (1, 3, {"data.max_examples_per_client": 128}),
@@ -301,6 +306,7 @@ def bench_config(name: str):
     # localizes a wall-clock regression to host inputs / placement /
     # dispatch (or a mid-bench retrace) without a profiler rerun —
     # drained BEFORE the device-time pass dispatches extra rounds
+    timed_compiles = exp.tracer.compile_stats()[0]
     phase_ms = {
         k: v["total_ms"] for k, v in exp.tracer.drain().items()
     }
@@ -335,11 +341,54 @@ def bench_config(name: str):
     extra["dispatch_bound"] = bool(
         flops_pct is None or flops_pct < DISPATCH_BOUND_MFU_PCT
     )
+    # Shape-waste accounting (r7): which step grids the timed rounds
+    # actually dispatched on, and how much of the padded grid was dead
+    # work — so a BENCH_* trajectory can attribute a throughput move to
+    # shape waste (or a bucket re-pin) rather than the kernels.
+    import numpy as _np
+
+    shape_stats = [
+        exp._comm_stats.get(r) for r in range(warmup, warmup + timed)
+    ]
+    shape_stats = [s for s in shape_stats if s]
+    if shape_stats and "padded_step_fraction" in shape_stats[0]:
+        extra["padded_step_fraction"] = round(float(_np.mean(
+            [s["padded_step_fraction"] for s in shape_stats]
+        )), 4)
+        extra["host_input_bytes_per_round"] = int(_np.mean(
+            [s["host_input_bytes"] for s in shape_stats]
+        ))
+    extra["shape_bucket_steps"] = sorted({
+        int(s["shape_bucket_steps"]) for s in shape_stats
+        if "shape_bucket_steps" in s
+    }) or [exp.shape.steps]
+    if exp._bucket_ladder is not None:
+        # compile budget: ≤ ladder-size retraces per engine; a NONZERO
+        # timed-region compile count means a rung first realized inside
+        # the timed window — visible here and as phase_ms["compile"]
+        extra["shape_bucket_ladder_steps"] = [
+            r * cfg.client.local_epochs for r in exp._bucket_ladder
+        ]
+        extra["timed_region_compiles"] = int(timed_compiles)
+        assert len(exp._seen_buckets) <= len(exp._bucket_ladder), (
+            exp._seen_buckets, exp._bucket_ladder
+        )
     if flops_per_round:
+        # raw MFU counts the FULL padded federation-max grid as useful
+        # work (the legacy accounting); effective MFU mask-weights it —
+        # only real examples' step FLOPs count, so the gap between the
+        # two IS the padded-FLOP waste shape buckets reclaim
+        step_flops = flops_per_round / (exp.shape.steps * cfg.server.cohort_size)
+        mean_examples = float(_np.mean([float(m.examples) for m in fetched]))
+        useful_flops = step_flops * mean_examples / cfg.client.batch_size
         extra.update({
             "model_tflops_per_round": round(flops_per_round / 1e12, 3),
             "achieved_tflops": round(flops_per_round * rounds_per_sec / 1e12, 2),
             "mfu_pct": round(flops_pct, 2),
+            "effective_mfu_pct": round(
+                100.0 * useful_flops * rounds_per_sec
+                / (PEAK_BF16_FLOPS * exp.n_chips), 2
+            ),
         })
     hbm = _hbm_stats()
     if hbm:

@@ -383,6 +383,40 @@ class ObsConfig:
 
 
 @dataclass
+class ShapeBucketsConfig:
+    """Heterogeneity-aware round shapes (``run.shape_buckets``): the
+    round grid's step count becomes a function of the SAMPLED COHORT,
+    not the federation. The federation-max ``steps_per_epoch`` is
+    quantized onto a small geometric ladder (top rung = the legacy full
+    shape); each round the driver picks the smallest rung covering the
+    realized cohort's max capped shard (per CHUNK under
+    ``run.fuse_rounds`` > 1, so fused slabs stay rectangular) and
+    dispatches through one lazily-compiled executable per realized rung.
+    Padded steps are exact algebraic no-ops, so a bucketed run is
+    BITWISE-EQUAL to the buckets-off run on the same seed (test-pinned,
+    sharded↔sequential and fused↔unfused) — only the mask-zeroed scan
+    iterations (real TPU FLOPs under power-law client sizes) disappear.
+    Compile budget: ≤ ladder-size retraces per engine, attributed via
+    the obs compile listener (``shape_bucket`` events).
+
+    Rejected pairings (validate(), each with its reason): example-level
+    DP (per-step noise keys are positional in the padded grid — a
+    trimmed grid would shift every noise stream), stragglers (their
+    truncation is parameterized on the full-shape step grid),
+    fedbuff/gossip (their schedulers own the round shape), and
+    ``run.host_pipeline='native'`` (the C++ pipeline builds for one
+    fixed shape; ``auto`` falls back to NumPy while buckets are on)."""
+
+    # off = exact-legacy behavior: every round pads to the federation max
+    enabled: bool = False
+    # geometric ladder ratio between adjacent rungs (> 1)
+    base: float = 2.0
+    # number of rungs below (and including) the full shape; the realized
+    # ladder is deduplicated, so count only bounds it
+    count: int = 4
+
+
+@dataclass
 class RunConfig:
     seed: int = 0
     # sharded: the shard_map/psum round engine (one XLA program per round)
@@ -474,6 +508,10 @@ class RunConfig:
     # BASELINE.md profile) while server aggregation and the cross-round
     # trajectory stay f32.
     local_param_dtype: str = ""
+    # Cohort-shaped step buckets — see ShapeBucketsConfig.
+    shape_buckets: ShapeBucketsConfig = field(
+        default_factory=ShapeBucketsConfig
+    )
     # Observability block (spans / counters / health) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
 
@@ -980,6 +1018,55 @@ class ExperimentConfig:
                     f"steps by chunks; an unaligned value would silently "
                     f"never trigger)"
                 )
+        sb = self.run.shape_buckets
+        if sb.base <= 1.0:
+            raise ValueError(
+                f"run.shape_buckets.base must be > 1, got {sb.base}"
+            )
+        if sb.count < 1:
+            raise ValueError(
+                f"run.shape_buckets.count must be >= 1, got {sb.count}"
+            )
+        if sb.enabled:
+            if self.algorithm in ("fedbuff", "gossip"):
+                # fedbuff's in-flight queue and gossip's all-clients
+                # round own their own shapes — there is no sampled
+                # cohort for the ladder to size against
+                raise ValueError(
+                    f"run.shape_buckets is incompatible with "
+                    f"algorithm={self.algorithm!r} (no sampled cohort "
+                    f"to size the step ladder against)"
+                )
+            if self.dp.enabled:
+                # local DP-SGD derives per-step noise keys by POSITION
+                # in the padded step grid (split(rng, steps)); trimming
+                # padded steps would shift the noise stream of every
+                # epoch after the first, breaking the bucketed==full
+                # bitwise contract
+                raise ValueError(
+                    "run.shape_buckets is incompatible with dp.enabled "
+                    "(per-step DP noise keys are positional in the "
+                    "padded step grid — trimming it shifts the streams)"
+                )
+            if self.server.straggler_rate > 0.0:
+                # straggler truncation cuts at a fraction of the FULL
+                # grid's steps; on a trimmed grid the same fraction cuts
+                # different examples, so bucketed != full
+                raise ValueError(
+                    "run.shape_buckets is incompatible with "
+                    "server.straggler_rate > 0 (straggler truncation is "
+                    "parameterized on the full-shape step grid)"
+                )
+            if self.run.host_pipeline == "native":
+                # the C++ pipeline is constructed for ONE fixed
+                # [steps, batch] grid and its own RNG streams; a
+                # bucketed run would silently change schedules vs the
+                # buckets-off run. 'auto' degrades to the NumPy path.
+                raise ValueError(
+                    "run.shape_buckets is incompatible with "
+                    "run.host_pipeline='native' (the C++ pipeline "
+                    "builds one fixed grid); use 'auto' or 'numpy'"
+                )
         if self.dp.clipping not in ("microbatch", "two_pass"):
             raise ValueError(
                 f"unknown dp.clipping {self.dp.clipping!r}"
@@ -1136,6 +1223,7 @@ class ExperimentConfig:
             "attack": AttackConfig,
             "run": RunConfig,
             "obs": ObsConfig,  # nested under run
+            "shape_buckets": ShapeBucketsConfig,  # nested under run
         }
         return build(cls, d)
 

@@ -2,18 +2,35 @@
 
 XLA traces one round program with fixed shapes; real clients have
 heterogeneous example counts. The resolution: every client-round is
-padded to the same ``[steps, batch]`` grid of example *indices* with a
-parallel validity mask, and the true example counts ride along for the
-FedAvg weighted sum. The index tensors are tiny (int32), generated on
-host with NumPy, and gathered **on device** against the HBM-resident
-example arrays — the host never moves example bytes during training.
+padded to the same ``[steps, batch]`` grid of example *indices*, with
+the true example counts riding along for the FedAvg weighted sum. The
+index tensors are tiny (int32), generated on host with NumPy, and
+gathered **on device** against the HBM-resident example arrays — the
+host never moves example bytes during training.
+
+Two r7 changes to the padding story:
+
+- **The validity mask is not shipped.** Padding is contiguous per epoch
+  (each epoch block holds its ``n`` real indices first, zeros after),
+  so the full ``[K, steps, batch]`` float32 mask is derivable from a
+  tiny ``[K, 2]`` int32 *spec* — ``(examples_per_epoch, valid_steps)``
+  — via ``iota < n`` comparisons. The engines rebuild the identical
+  mask on device (round_engine ``on_device_mask``); the host ships only
+  the spec, roughly halving round-input wire bytes.
+- **Step buckets** (``run.shape_buckets``): the grid's step count can
+  be quantized per round onto a small geometric ladder sized by the
+  *sampled cohort's* max requirement instead of the federation max —
+  padded steps are exact algebraic no-ops, so trimming them is bitwise
+  neutral (pinned by tests/test_shape_buckets.py) while skipping the
+  mask-zeroed scan iterations entirely. :func:`bucket_ladder` /
+  :func:`pick_bucket` hold the ladder math.
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -50,6 +67,220 @@ def compute_round_shape(
     )
 
 
+# ---------------------------------------------------------------------------
+# step-bucket ladder (run.shape_buckets)
+# ---------------------------------------------------------------------------
+
+
+def bucket_ladder(steps_per_epoch: int, base: float, count: int) -> List[int]:
+    """Geometric ladder of steps-per-epoch bucket values, ascending.
+
+    The top rung is always the federation-max ``steps_per_epoch`` (the
+    legacy full shape, so every cohort fits); lower rungs divide it by
+    ``base`` repeatedly, floored at 1 and deduplicated. The ladder size
+    bounds the compile budget: one round executable per *realized* rung.
+    """
+    if steps_per_epoch < 1:
+        raise ValueError(f"steps_per_epoch must be >= 1, got {steps_per_epoch}")
+    if base <= 1.0:
+        raise ValueError(f"shape_buckets.base must be > 1, got {base}")
+    if count < 1:
+        raise ValueError(f"shape_buckets.count must be >= 1, got {count}")
+    rungs = {
+        max(1, math.ceil(steps_per_epoch / base**i)) for i in range(count)
+    }
+    rungs.add(steps_per_epoch)
+    return sorted(rungs)
+
+
+def pick_bucket(needed_steps_per_epoch: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder rung covering the cohort's step requirement."""
+    for rung in ladder:
+        if rung >= needed_steps_per_epoch:
+            return rung
+    raise ValueError(
+        f"no ladder rung covers steps_per_epoch={needed_steps_per_epoch} "
+        f"(ladder {list(ladder)}) — the top rung must be the full shape"
+    )
+
+
+# ---------------------------------------------------------------------------
+# round-index construction
+# ---------------------------------------------------------------------------
+
+
+def _round_draws(rng: np.random.Generator, k: int, max_len: int,
+                 cap_eff: int, local_epochs: int):
+    """The round's host randomness, drawn as two dense blocks so the
+    vectorized builder and the per-row reference consume the stream
+    identically: ``sel`` keys order each client's shard (cap
+    subsampling = the first ``cap`` of that order), ``perm`` keys order
+    each epoch's selected subset."""
+    sel = rng.random((k, max_len))
+    perm = rng.random((k, local_epochs, cap_eff))
+    return sel, perm
+
+
+def make_round_spec(
+    fed: FederatedData,
+    cohort_ids: Sequence[int],
+    shape: RoundShape,
+    rng: np.random.Generator,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build (idx, spec, n_examples) for one round's cohort.
+
+    idx:        [K, steps, batch] int32 — gather indices into train_x/
+                train_y; padding positions point at index 0. Real
+                indices pack CONTIGUOUSLY at the head of each epoch
+                block — the invariant that makes the mask derivable.
+    spec:       [K, 2] int32 — (examples per epoch, valid steps). The
+                full float32 validity mask is ``mask_from_spec(spec,
+                shape)``; the engines rebuild it on device instead of
+                shipping the [K, steps, batch] slab.
+    n_examples: [K] float32 — real examples processed this round (the
+                FedAvg weight; proportional to |D_i| at equal epochs).
+
+    Fully vectorized over (clients × epochs): one argsort-ordered key
+    block replaces the O(K·E) per-row ``rng.permutation`` loop that
+    used to sit on the round loop's host hot path. The per-row
+    reference twin (``_make_round_spec_loop``) consumes the identical
+    draws; equality is pinned by tests/test_loader.py. The random
+    draws depend only on the cohort's shard lengths and the cap —
+    never on the grid shape — so a bucketed (smaller-``steps``) grid
+    packs the *same* example order as the full grid.
+    """
+    k = len(cohort_ids)
+    steps, batch = shape.steps, shape.batch_size
+    epochs, per_epoch = shape.local_epochs, shape.steps_per_epoch * batch
+    if k == 0:
+        return (
+            np.zeros((0, steps, batch), np.int32),
+            np.zeros((0, 2), np.int32),
+            np.zeros((0,), np.float32),
+        )
+    shards = [np.asarray(fed.client_indices[c]) for c in cohort_ids]
+    lens = np.array([len(s) for s in shards], np.int64)
+    max_len = int(lens.max()) if k else 0
+    take = np.minimum(lens, shape.cap)
+    cap_eff = int(take.max())
+    if cap_eff > per_epoch:
+        raise ValueError(
+            f"round grid holds {per_epoch} examples/epoch but the cohort "
+            f"max is {cap_eff} — steps_per_epoch={shape.steps_per_epoch} "
+            f"is too small for this cohort"
+        )
+    sel_keys, perm_keys = _round_draws(rng, k, max_len, cap_eff, epochs)
+
+    # padded [K, max_len] shard matrix; rows shorter than max_len carry
+    # +inf selection keys so their tail never sorts into the head
+    row_pos = np.arange(max_len)[None, :]
+    in_shard = row_pos < lens[:, None]
+    padded = np.zeros((k, max_len), np.int64)
+    if max_len:
+        padded[in_shard] = np.concatenate(shards)
+        sel_keys = np.where(in_shard, sel_keys, np.inf)
+    order = np.argsort(sel_keys, axis=1, kind="stable")
+    # chosen[i, :take[i]] is a uniform random subset (and order) of the
+    # shard — cap subsampling and full-shard selection in one expression
+    chosen = np.take_along_axis(padded, order, axis=1)[:, :cap_eff]
+
+    # per-epoch permutation of each client's selected subset
+    sel_pos = np.arange(cap_eff)[None, None, :]
+    keyed = np.where(sel_pos < take[:, None, None], perm_keys, np.inf)
+    ep_order = np.argsort(keyed, axis=2, kind="stable")
+    perm = np.take_along_axis(
+        np.broadcast_to(chosen[:, None, :], (k, epochs, cap_eff)),
+        ep_order, axis=2,
+    )
+
+    # pack: epoch block e of row i holds perm[i, e, :take[i]] first,
+    # zeros after (contiguous padding — the mask-spec invariant)
+    idx = np.zeros((k, epochs, per_epoch), np.int32)
+    valid = np.broadcast_to(sel_pos < take[:, None, None], perm.shape)
+    idx[:, :, :cap_eff][valid] = perm[valid].astype(np.int32)
+    spec = np.stack(
+        [take.astype(np.int64), np.full(k, steps, np.int64)], axis=1
+    ).astype(np.int32)
+    n_examples = (take * epochs).astype(np.float32)
+    return idx.reshape(k, steps, batch), spec, n_examples
+
+
+def _make_round_spec_loop(fed, cohort_ids, shape: RoundShape,
+                          rng: np.random.Generator):
+    """Per-row/per-epoch reference twin of :func:`make_round_spec`:
+    identical draws (``_round_draws``), straightforward Python loops for
+    the ordering and packing. Exists so the vectorized builder's argsort
+    and scatter algebra is pinned against an obviously-correct loop
+    (tests/test_loader.py)."""
+    k = len(cohort_ids)
+    steps, batch = shape.steps, shape.batch_size
+    epochs, per_epoch = shape.local_epochs, shape.steps_per_epoch * batch
+    shards = [np.asarray(fed.client_indices[c]) for c in cohort_ids]
+    lens = [len(s) for s in shards]
+    max_len = max(lens) if k else 0
+    take = [min(n, shape.cap) for n in lens]
+    cap_eff = max(take) if k else 0
+    sel_keys, perm_keys = _round_draws(rng, k, max_len, cap_eff, epochs)
+    idx = np.zeros((k, steps * batch), np.int32)
+    spec = np.zeros((k, 2), np.int32)
+    n_examples = np.zeros((k,), np.float32)
+    for i in range(k):
+        order = np.argsort(sel_keys[i, : lens[i]], kind="stable")
+        chosen = shards[i][order][: take[i]]
+        for e in range(epochs):
+            ep = np.argsort(perm_keys[i, e, : take[i]], kind="stable")
+            off = e * per_epoch
+            idx[i, off : off + take[i]] = chosen[ep].astype(np.int32)
+        spec[i] = (take[i], steps)
+        n_examples[i] = take[i] * epochs
+    return idx.reshape(k, steps, batch), spec, n_examples
+
+
+def mask_from_spec(spec: np.ndarray, shape: RoundShape) -> np.ndarray:
+    """Expand a ``[K, 2]`` spec into the full ``[K, steps, batch]``
+    float32 validity mask — the NumPy twin of the engines' on-device
+    reconstruction (round_engine ``_mask_from_spec``); both must equal
+    the legacy shipped mask bit-for-bit (0.0/1.0 exactly)."""
+    return expand_mask_spec(
+        np.asarray(spec), shape.steps, shape.batch_size, shape.local_epochs
+    )
+
+
+def expand_mask_spec(spec: np.ndarray, steps: int, batch: int,
+                     local_epochs: int) -> np.ndarray:
+    """Shape-parameter form of :func:`mask_from_spec` (the engines know
+    the grid dims, not a RoundShape). A position is valid iff its flat
+    offset within its epoch block is below the client's per-epoch
+    example count AND its step is below the client's valid-step bound
+    (straggler truncation sets the latter)."""
+    if steps % local_epochs:
+        raise ValueError(
+            f"steps={steps} not a multiple of local_epochs={local_epochs}"
+        )
+    spe = steps // local_epochs
+    s = np.arange(steps)[None, :, None]
+    b = np.arange(batch)[None, None, :]
+    pos = (s % spe) * batch + b
+    n_ep = spec[:, 0][:, None, None]
+    vsteps = spec[:, 1][:, None, None]
+    return ((pos < n_ep) & (s < vsteps)).astype(np.float32)
+
+
+def spec_examples(spec: np.ndarray, shape: RoundShape) -> np.ndarray:
+    """Closed-form ``mask_from_spec(spec, shape).sum((1, 2))`` — the
+    real example count per client under the spec's per-epoch count and
+    valid-step bound (exact integer math, cast to the f32 the FedAvg
+    weights ride)."""
+    spe, batch = shape.steps_per_epoch, shape.batch_size
+    n = spec[:, 0].astype(np.int64)
+    vsteps = spec[:, 1].astype(np.int64)
+    total = np.zeros(len(spec), np.int64)
+    for e in range(shape.local_epochs):
+        avail = np.clip(vsteps - e * spe, 0, spe)
+        total += np.minimum(n, avail * batch)
+    return total.astype(np.float32)
+
+
 def make_round_indices(
     fed: FederatedData,
     cohort_ids: Sequence[int],
@@ -58,34 +289,14 @@ def make_round_indices(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Build (idx, mask, n_examples) for one round's cohort.
 
-    idx:        [K, steps, batch] int32 — gather indices into train_x/train_y
-                (padding positions point at index 0 and are masked out)
-    mask:       [K, steps, batch] float32 — 1.0 on real examples
-    n_examples: [K] float32 — real examples processed this round (the
-                FedAvg weight; proportional to |D_i| at equal epochs)
+    The legacy full-mask interface: ``mask`` is the [K, steps, batch]
+    float32 validity mask, expanded host-side from the compact spec.
+    The driver's engines no longer consume this form (they rebuild the
+    mask on device from the spec — :func:`make_round_spec`); the gossip
+    and fedbuff paths, and shape-level tests, still do.
     """
-    k = len(cohort_ids)
-    steps, batch = shape.steps, shape.batch_size
-    idx = np.zeros((k, steps * batch), np.int32)
-    mask = np.zeros((k, steps * batch), np.float32)
-    n_examples = np.zeros((k,), np.float32)
-    per_epoch = shape.steps_per_epoch * batch
-    for row, cid in enumerate(cohort_ids):
-        ids = fed.client_indices[cid]
-        if len(ids) > shape.cap:
-            ids = rng.choice(ids, size=shape.cap, replace=False)
-        n = len(ids)
-        for e in range(shape.local_epochs):
-            perm = rng.permutation(ids).astype(np.int32)
-            off = e * per_epoch
-            idx[row, off : off + n] = perm
-            mask[row, off : off + n] = 1.0
-        n_examples[row] = n * shape.local_epochs
-    return (
-        idx.reshape(k, steps, batch),
-        mask.reshape(k, steps, batch),
-        n_examples,
-    )
+    idx, spec, n_examples = make_round_spec(fed, cohort_ids, shape, rng)
+    return idx, mask_from_spec(spec, shape), n_examples
 
 
 def eval_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
@@ -95,6 +306,14 @@ def eval_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     jitted eval loop sees one static shape.
     """
     n = len(x)
+    if n == 0:
+        # padding repeats x[:1]; an empty shard has no row to repeat —
+        # fail with the real cause instead of a bare IndexError deep in
+        # np.repeat (empty silo shards reach here via federated eval)
+        raise ValueError(
+            "eval_batches requires at least one example; got an empty "
+            "array (empty client shard or empty test split)"
+        )
     n_batches = max(1, math.ceil(n / batch_size))
     total = n_batches * batch_size
     pad = total - n

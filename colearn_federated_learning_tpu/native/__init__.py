@@ -68,7 +68,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.clp_create.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
         ]
         lib.clp_destroy.argtypes = [ctypes.c_void_p]
         lib.clp_submit.restype = ctypes.c_int
@@ -111,7 +111,7 @@ class NativeRoundPipeline:
 
     def __init__(self, client_indices: Sequence[np.ndarray], local_epochs: int,
                  steps_per_epoch: int, batch: int, cap: int, seed: int,
-                 n_threads: int = 0):
+                 n_threads: int = 0, build_mask: bool = True):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native pipeline unavailable: {_BUILD_ERROR}")
@@ -122,6 +122,12 @@ class NativeRoundPipeline:
                else np.zeros(0, np.int64)).astype(np.int32)
         self._steps = local_epochs * steps_per_epoch
         self._batch = batch
+        # build_mask=False: the engines rebuild the validity mask on
+        # device from the [K, 2] spec, so the pipeline neither builds
+        # nor copies the float mask slab (prefetch memory and the fetch
+        # memcpy shrink by k*steps*batch*4 bytes); fetch returns None
+        # in the mask slot
+        self._build_mask = build_mask
         if n_threads <= 0:
             n_threads = min(8, max(2, (os.cpu_count() or 2) - 1))
         # keep the arrays alive through the create call
@@ -129,6 +135,7 @@ class NativeRoundPipeline:
             _ptr(offsets, ctypes.c_int64), _ptr(ids, ctypes.c_int32),
             len(client_indices), local_epochs, steps_per_epoch, batch, cap,
             ctypes.c_uint64(seed & (2**64 - 1)), n_threads,
+            1 if build_mask else 0,
         )
         if not self._h:
             raise RuntimeError("clp_create failed")
@@ -141,13 +148,17 @@ class NativeRoundPipeline:
         if rc != 0:
             raise RuntimeError(f"clp_submit rc={rc}")
 
-    def fetch(self, round_idx: int, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def fetch(self, round_idx: int, k: int
+              ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
         idx = np.empty((k, self._steps, self._batch), np.int32)
-        mask = np.empty((k, self._steps, self._batch), np.float32)
+        mask = (np.empty((k, self._steps, self._batch), np.float32)
+                if self._build_mask else None)
         n_ex = np.empty((k,), np.float32)
         rc = self._lib.clp_fetch(
             self._h, round_idx, k,
-            _ptr(idx, ctypes.c_int32), _ptr(mask, ctypes.c_float),
+            _ptr(idx, ctypes.c_int32),
+            (_ptr(mask, ctypes.c_float) if mask is not None
+             else ctypes.POINTER(ctypes.c_float)()),
             _ptr(n_ex, ctypes.c_float),
         )
         if rc != 0:

@@ -85,6 +85,10 @@ struct Pipeline {
   std::vector<int32_t> ids;
   int32_t local_epochs, steps_per_epoch, batch, cap;
   uint64_t seed;
+  // r7: when the engines rebuild the validity mask on device from the
+  // [K, 2] spec, the pipeline skips the float mask slab entirely —
+  // prefetch memory and fetch memcpy shrink by k*steps*batch*4 bytes
+  bool build_mask = true;
 
   std::mutex mu;
   std::condition_variable cv_work, cv_done;
@@ -122,9 +126,11 @@ struct Pipeline {
         std::swap(perm[i], perm[j]);
       }
       int32_t* out = idx_row + e * per_epoch;
-      float* mout = mask_row + e * per_epoch;
       std::memcpy(out, perm.data(), take * sizeof(int32_t));
-      for (int64_t i = 0; i < take; ++i) mout[i] = 1.0f;
+      if (mask_row) {
+        float* mout = mask_row + e * per_epoch;
+        for (int64_t i = 0; i < take; ++i) mout[i] = 1.0f;
+      }
       // padding stays 0 (index 0, mask 0) — masked no-ops on device
     }
     *n_out = (float)(take * local_epochs);
@@ -135,11 +141,12 @@ struct Pipeline {
     const int64_t steps = (int64_t)local_epochs * steps_per_epoch;
     const int64_t row_len = steps * batch;
     slot.idx.assign(k * row_len, 0);
-    slot.mask.assign(k * row_len, 0.0f);
+    if (build_mask) slot.mask.assign(k * row_len, 0.0f);
     slot.n_ex.assign(k, 0.0f);
     for (int64_t r = 0; r < k; ++r) {
       fill_row(job.round, job.cohort[r], slot.idx.data() + r * row_len,
-               slot.mask.data() + r * row_len, slot.n_ex.data() + r);
+               build_mask ? slot.mask.data() + r * row_len : nullptr,
+               slot.n_ex.data() + r);
     }
   }
 
@@ -172,7 +179,8 @@ extern "C" {
 
 void* clp_create(const int64_t* offsets, const int32_t* ids, int64_t n_clients,
                  int32_t local_epochs, int32_t steps_per_epoch, int32_t batch,
-                 int32_t cap, uint64_t seed, int32_t n_threads) {
+                 int32_t cap, uint64_t seed, int32_t n_threads,
+                 int32_t build_mask) {
   auto* p = new Pipeline();
   p->offsets.assign(offsets, offsets + n_clients + 1);
   p->ids.assign(ids, ids + offsets[n_clients]);
@@ -181,6 +189,7 @@ void* clp_create(const int64_t* offsets, const int32_t* ids, int64_t n_clients,
   p->batch = batch;
   p->cap = cap;
   p->seed = seed;
+  p->build_mask = build_mask != 0;
   if (n_threads < 1) n_threads = 1;
   for (int32_t i = 0; i < n_threads; ++i)
     p->workers.emplace_back([p] { p->worker_loop(); });
@@ -215,6 +224,8 @@ int clp_submit(void* h, int64_t round, const int32_t* cohort, int32_t k) {
 }
 
 // Blocking fetch; copies into caller buffers and frees the slot.
+// `mask` may be NULL when the pipeline was created with build_mask=0
+// (the engines rebuild the validity mask on device from the spec).
 // Returns 0 on success, -1 if the round was never submitted, -2 on a
 // cohort-size mismatch.
 int clp_fetch(void* h, int64_t round, int32_t k, int32_t* idx, float* mask,
@@ -227,7 +238,8 @@ int clp_fetch(void* h, int64_t round, int32_t k, int32_t* idx, float* mask,
   Slot& s = it->second;
   if ((int64_t)s.n_ex.size() != k) return -2;
   std::memcpy(idx, s.idx.data(), s.idx.size() * sizeof(int32_t));
-  std::memcpy(mask, s.mask.data(), s.mask.size() * sizeof(float));
+  if (mask && !s.mask.empty())
+    std::memcpy(mask, s.mask.data(), s.mask.size() * sizeof(float));
   std::memcpy(n_ex, s.n_ex.data(), s.n_ex.size() * sizeof(float));
   p->slots.erase(it);
   return 0;

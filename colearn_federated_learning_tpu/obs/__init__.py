@@ -22,6 +22,8 @@ from colearn_federated_learning_tpu.obs.counters import (  # noqa: F401
     device_memory_stats,
     gossip_round_bytes,
     round_comm_bytes,
+    round_host_input_bytes,
+    round_shape_stats,
 )
 from colearn_federated_learning_tpu.obs.health import (  # noqa: F401
     HealthAbortError,

@@ -89,6 +89,60 @@ def gossip_round_bytes(num_clients: int, mixing_steps: int, topology: str,
     }
 
 
+def round_host_input_bytes(k: int, steps: int, batch: int,
+                           on_device_mask: bool) -> int:
+    """Analytic host→device wire bytes for one round's index inputs:
+    the ``[K, steps, batch]`` int32 gather indices, the validity-mask
+    input — the full ``[K, steps, batch]`` float32 slab on the legacy
+    path, the ``[K, 2]`` int32 spec when the engine rebuilds the mask
+    on device — and the ``[K]`` float32 FedAvg weights. Same
+    pure-function honesty contract as :func:`round_comm_bytes`: this is
+    what the configured input format WOULD move, so removing the mask
+    slab shows up as exactly its byte count."""
+    idx_b = int(k) * int(steps) * int(batch) * 4
+    mask_b = int(k) * 2 * 4 if on_device_mask else idx_b
+    return idx_b + mask_b + int(k) * 4
+
+
+def round_shape_stats(spec, steps: int, batch: int,
+                      local_epochs: int) -> Dict[str, float]:
+    """Padded-step / wasted-FLOP gauges for one round's ``[K, 2]`` mask
+    spec on a ``steps × batch`` grid.
+
+    - ``padded_step_fraction``: fraction of the cohort's scan steps
+      that are complete no-ops (no real example) — each costs a full
+      training step of device FLOPs on the padded grid.
+    - ``padded_example_fraction``: fraction of grid POSITIONS that are
+      padding (counts partially-filled tail batches too — the
+      mask-weighted FLOP waste, the complement of effective MFU).
+    """
+    import numpy as np
+
+    spec = np.asarray(spec)
+    k = len(spec)
+    if k == 0 or steps == 0:
+        return {"padded_step_fraction": 0.0, "padded_example_fraction": 0.0}
+    spe = max(1, steps // max(1, local_epochs))
+    n = spec[:, 0].astype(np.int64)
+    vsteps = spec[:, 1].astype(np.int64)
+    real_steps = np.zeros(k, np.int64)
+    real_examples = np.zeros(k, np.int64)
+    for e in range(local_epochs):
+        avail = np.clip(vsteps - e * spe, 0, spe)
+        real_steps += np.minimum(-(-n // batch), avail)
+        real_examples += np.minimum(n, avail * batch)
+    total_steps = k * steps
+    total_examples = total_steps * batch
+    return {
+        "padded_step_fraction": round(
+            1.0 - float(real_steps.sum()) / total_steps, 4
+        ),
+        "padded_example_fraction": round(
+            1.0 - float(real_examples.sum()) / total_examples, 4
+        ),
+    }
+
+
 def device_memory_stats() -> Dict[str, int]:
     """Current device-memory gauges from ``jax`` memory stats, or ``{}``
     when the backend reports none (CPU, older runtimes)."""

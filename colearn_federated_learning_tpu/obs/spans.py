@@ -170,6 +170,15 @@ class Tracer:
 
     # ------------------------------------------------------------------
 
+    def compile_stats(self) -> tuple:
+        """Non-draining snapshot of the backend_compile listener's
+        counters since the last :meth:`drain`: ``(count, total_secs)``.
+        The driver brackets a dispatch with two snapshots to attribute
+        compiles to the shape bucket that triggered them (the
+        per-bucket retrace accounting of ``run.shape_buckets``)."""
+        with self._lock:
+            return self._compiles, self._compile_secs
+
     def drain(self) -> Dict[str, Dict[str, float]]:
         """Return and reset the per-phase aggregates since the last
         drain: ``{phase: {count, total_ms, max_ms}}``, with compiles

@@ -50,6 +50,35 @@ class RoundMetrics(NamedTuple):
     examples: jnp.ndarray  # total real examples processed
 
 
+def _mask_from_spec(spec, steps: int, batch_local: int, local_epochs: int,
+                    batch_total: int, batch_offset):
+    """Rebuild the ``[C, steps, batch]`` float32 validity mask from the
+    ``[C, 2]`` int32 ``(examples_per_epoch, valid_steps)`` spec.
+
+    Padding is contiguous per epoch (data/loader.py packs each epoch's
+    real indices first), so a position is valid iff its flat offset
+    within its epoch block sits below the client's per-epoch example
+    count and its step below the valid-step bound (straggler
+    truncation). Produces EXACTLY the 0.0/1.0 float32 values the host
+    used to ship — the engines' bitwise contracts are unchanged; only
+    the host→device bytes are (a [K, 2] spec instead of the
+    [K, steps, batch] slab). Under a batch-sharded mesh each shard
+    rebuilds its own columns: ``batch_offset`` is the shard's global
+    column origin, so the flat offsets agree with the unsharded mask.
+    """
+    if steps % local_epochs:
+        raise ValueError(
+            f"steps={steps} not a multiple of local_epochs={local_epochs}"
+        )
+    spe = steps // local_epochs
+    s = jax.lax.broadcasted_iota(jnp.int32, (steps, batch_local), 0)
+    b = jax.lax.broadcasted_iota(jnp.int32, (steps, batch_local), 1)
+    pos = (s % spe) * batch_total + b + batch_offset
+    n_ep = spec[:, 0][:, None, None]
+    vsteps = spec[:, 1][:, None, None]
+    return ((pos[None] < n_ep) & (s[None] < vsteps)).astype(jnp.float32)
+
+
 def _decay_scale(decay: float, server_opt_state):
     """lr multiplier decay^round from the server state's round counter."""
     r = server_opt_state["round"].astype(jnp.float32)
@@ -499,7 +528,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           fuse_rounds: int = 1,
                           attack: str = "",
                           attack_scale: float = 10.0,
-                          attack_eps: float = 1.0):
+                          attack_eps: float = 1.0,
+                          on_device_mask: bool = False):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -507,6 +537,16 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         (params, server_opt_state, train_x, train_y,
          idx [K,steps,batch], mask [K,steps,batch], n_ex [K], rng)
         → (new_params, new_server_opt_state, RoundMetrics)
+
+    ``on_device_mask``: the ``mask`` input is the compact ``[K, 2]``
+    int32 ``(examples_per_epoch, valid_steps)`` spec instead of the
+    full ``[K, steps, batch]`` float32 slab; each lane rebuilds its
+    mask shard in-program via ``broadcasted_iota < n``
+    (:func:`_mask_from_spec`) — bit-identical to the shipped mask, at
+    ~half the round's host→device wire bytes. The grid's step count is
+    read off ``idx``, so one engine serves every ``run.shape_buckets``
+    rung (jit caches one executable per realized [K, steps, batch]
+    shape — the ladder bounds the retrace budget).
 
     ``n_ex`` are the per-client example counts; simulated client dropout
     (SURVEY.md §5) is upstream zeroing of entries — exact math, no
@@ -716,6 +756,17 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # idx/mask: [C, steps, batch] — this lane's chunk of the cohort
         # Mark params as device-varying so scan carries (which mix in
         # per-lane data) type-check under shard_map's vma system.
+        if on_device_mask:
+            # mask arrived as the [C, 2] spec; rebuild this lane's (and,
+            # under a batch axis, this shard's) mask columns in-program
+            off = (
+                jax.lax.axis_index(BATCH_AXIS) * idx.shape[2]
+                if batch_sharded else 0
+            )
+            mask = _mask_from_spec(
+                mask, idx.shape[1], idx.shape[2], client_cfg.local_epochs,
+                client_cfg.batch_size, off,
+            )
         rest = list(rest)
         lr_scale = rest.pop(0) if use_decay else None
         c_global, c_cohort, c_all, state_pos = None, None, None, None
@@ -1017,10 +1068,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
     # [K, steps, batch] index/mask tensors additionally shard the batch
     # dim over the batch axis when present; n_ex/keys stay per-client.
+    # The compact mask SPEC has no batch dim — cohort over lanes only.
     cohort_spec = (
         P(CLIENT_AXIS, None, BATCH_AXIS) if batch_sharded else P(CLIENT_AXIS)
     )
-    in_specs = (P(), P(), P(), cohort_spec, cohort_spec, P(CLIENT_AXIS), P(CLIENT_AXIS))
+    mask_in_spec = P(CLIENT_AXIS) if on_device_mask else cohort_spec
+    in_specs = (P(), P(), P(), cohort_spec, mask_in_spec, P(CLIENT_AXIS), P(CLIENT_AXIS))
     if use_decay:
         in_specs += (P(),)  # lr_scale scalar, replicated
     if stateful:
@@ -1494,7 +1547,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              error_feedback: bool = False,
                              attack: str = "",
                              attack_scale: float = 10.0,
-                             attack_eps: float = 1.0):
+                             attack_eps: float = 1.0,
+                             on_device_mask: bool = False):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -1502,7 +1556,11 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     ``error_feedback`` and ``aggregator`` mirror the sharded engine's
     signature exactly (under ``error_feedback`` the round fn takes the
     cohort's e-rows as ``c_cohort`` — ``c_global`` stays None — and
-    returns ``(params, opt_state, new_e_cohort, metrics)``)."""
+    returns ``(params, opt_state, new_e_cohort, metrics)``).
+    ``on_device_mask`` mirrors the sharded engine's compact-spec mask
+    input: ``mask`` arrives as the ``[K, 2]`` spec and is expanded to
+    the identical full float32 mask before the loop (the loop itself is
+    the oracle — it sees exactly what the lanes rebuild in-program)."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
@@ -1551,6 +1609,17 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                  c_global=None, c_cohort=None, pair_seeds=None, byz=None):
         if attack and byz is None:
             raise TypeError(f"attack={attack!r} requires the byz mask input")
+        if on_device_mask:
+            import numpy as _np
+
+            from colearn_federated_learning_tpu.data.loader import (
+                expand_mask_spec,
+            )
+
+            mask = expand_mask_spec(
+                _np.asarray(mask), idx.shape[1], idx.shape[2],
+                client_cfg.local_epochs,
+            )
         k = idx.shape[0]
         keys = jax.random.split(rng, k)
         lr_scale = (

@@ -25,9 +25,14 @@ from colearn_federated_learning_tpu.client.trainer import (
 from colearn_federated_learning_tpu.config import DPConfig, ExperimentConfig
 from colearn_federated_learning_tpu.data import build_federated_data
 from colearn_federated_learning_tpu.data.loader import (
+    RoundShape,
+    bucket_ladder,
     compute_round_shape,
     eval_batches,
     make_round_indices,
+    make_round_spec,
+    pick_bucket,
+    spec_examples,
 )
 from colearn_federated_learning_tpu.models import build_model
 from colearn_federated_learning_tpu.obs import (
@@ -37,6 +42,8 @@ from colearn_federated_learning_tpu.obs import (
     device_memory_stats,
     gossip_round_bytes,
     round_comm_bytes,
+    round_host_input_bytes,
+    round_shape_stats,
 )
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
 from colearn_federated_learning_tpu.parallel.round_engine import (
@@ -82,6 +89,14 @@ class Experiment:
         self.fed = build_federated_data(cfg.data, seed=cfg.run.seed, **cfg.model.kwargs)
         self.task = self.fed.task
         self.shape = compute_round_shape(self.fed, cfg.client, cfg.data)
+        # On-device masks (r7): the synchronous cohort paths ship the
+        # compact [K, 2] (examples_per_epoch, valid_steps) spec instead
+        # of the [K, steps, batch] float32 mask slab — the engines
+        # rebuild the identical mask in-program (round_engine
+        # `on_device_mask`), roughly halving round-input wire bytes.
+        # gossip and fedbuff keep the legacy full-mask inputs (their
+        # engines consume it directly).
+        self._spec_inputs = cfg.algorithm not in ("gossip", "fedbuff")
         self.sampler = CohortSampler(
             self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
             weights=(
@@ -104,6 +119,28 @@ class Experiment:
             self._poisson_cap = min(
                 _n, _k + _math.ceil(5.0 * _math.sqrt(_k * (1.0 - _q))) + 1
             )
+        # Heterogeneity-aware round shapes (run.shape_buckets, r7): the
+        # federation-max steps_per_epoch is quantized onto a geometric
+        # ladder; each round (chunk, under fusion) dispatches on the
+        # smallest rung covering the SAMPLED cohort's max capped shard.
+        # The bucket for a round is a pure function of (seed, round) —
+        # resume and the stream-prefetch worker recompute it for free —
+        # and jit caches one executable per realized [K, steps, batch]
+        # shape, so the compile budget is bounded by the ladder size
+        # (per-bucket attribution: _bucket_compile_span).
+        self._sizes_capped = np.minimum(
+            self.fed.client_sizes(), self.shape.cap
+        ).astype(np.int64)
+        sb = cfg.run.shape_buckets
+        self._bucket_ladder = (
+            bucket_ladder(self.shape.steps_per_epoch, sb.base, sb.count)
+            if sb.enabled else None
+        )
+        self._bucket_cache: Dict[int, int] = {}
+        self._bucket_shapes: Dict[int, RoundShape] = {
+            self.shape.steps_per_epoch: self.shape
+        }
+        self._seen_buckets: set = set()
         self.server_opt_init, server_update = make_server_update_fn(cfg.server)
         # SCAFFOLD (cfg.algorithm): per-client control variates live as
         # one stacked [N_pad, ...] tree per leaf. Under the sharded
@@ -306,6 +343,7 @@ class Experiment:
                         ),
                         attack_scale=cfg.attack.scale,
                         attack_eps=cfg.attack.eps,
+                        on_device_mask=self._spec_inputs,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
@@ -350,6 +388,7 @@ class Experiment:
                 attack=self.attack_kind if self._attack_upload else "",
                 attack_scale=cfg.attack.scale,
                 attack_eps=cfg.attack.eps,
+                on_device_mask=self._spec_inputs,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -492,7 +531,12 @@ class Experiment:
                 "server.sampling=poisson (variable cohorts are padded "
                 "host-side); use host_pipeline=numpy"
             )
-        if cfg.run.host_pipeline in ("auto", "native") and not self._poisson:
+        if (cfg.run.host_pipeline in ("auto", "native")
+                and not self._poisson
+                # bucketed grids vary per round; the C++ pipeline builds
+                # ONE fixed shape (validate() rejects the explicit
+                # 'native' pairing; 'auto' degrades to NumPy here)
+                and self._bucket_ladder is None):
             from colearn_federated_learning_tpu import native
 
             if native.available():
@@ -501,6 +545,9 @@ class Experiment:
                     self.shape.local_epochs, self.shape.steps_per_epoch,
                     self.shape.batch_size, self.shape.cap,
                     seed=cfg.run.seed,
+                    # spec-input engines rebuild the mask on device —
+                    # the pipeline skips the float mask slab entirely
+                    build_mask=not self._spec_inputs,
                 )
             elif cfg.run.host_pipeline == "native":
                 raise RuntimeError(
@@ -831,10 +878,80 @@ class Experiment:
             state["queue_next_seq"] = int(state["queue_next_seq"])
         return state
 
-    def _host_inputs(self, round_idx: int):
+    # ---- heterogeneity-aware round shapes (run.shape_buckets) --------
+
+    def _round_bucket_spe(self, round_idx: int) -> int:
+        """The ladder rung (steps_per_epoch) for one round: smallest
+        rung whose grid holds the SAMPLED cohort's max capped shard.
+        Pure in (seed, round) — the sampler is stateless, so the
+        prefetch worker, a resume, and the fused chunk-max computation
+        all agree without coordination."""
+        spe = self._bucket_cache.get(round_idx)
+        if spe is None:
+            cohort = np.asarray(self.sampler.sample(round_idx))
+            max_need = (
+                int(self._sizes_capped[cohort].max()) if len(cohort) else 1
+            )
+            need = max(1, -(-max_need // self.shape.batch_size))
+            spe = pick_bucket(need, self._bucket_ladder)
+            self._bucket_cache[round_idx] = spe
+        return spe
+
+    def _bucket_shape(self, spe: int) -> RoundShape:
+        import dataclasses as _dc
+
+        shp = self._bucket_shapes.get(spe)
+        if shp is None:
+            shp = _dc.replace(self.shape, steps_per_epoch=spe)
+            self._bucket_shapes[spe] = shp
+        return shp
+
+    def _round_shape(self, round_idx: int) -> RoundShape:
+        """The round's grid shape: a ladder rung under shape buckets,
+        the federation-max legacy shape otherwise."""
+        if self._bucket_ladder is None:
+            return self.shape
+        return self._bucket_shape(self._round_bucket_spe(round_idx))
+
+    def _bucket_compile_span(self, round_idx: int, steps: int):
+        """Context manager wrapping the FIRST dispatch on a new ladder
+        rung: brackets the tracer's backend_compile counters and logs a
+        `shape_bucket` event attributing the rung's retrace cost — the
+        per-bucket compile accounting the ≤-ladder-size budget is
+        asserted against (tests/test_shape_buckets.py)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def span():
+            if self._bucket_ladder is None or steps in self._seen_buckets:
+                yield
+                return
+            self._seen_buckets.add(steps)
+            c0, s0 = self.tracer.compile_stats()
+            yield
+            c1, s1 = self.tracer.compile_stats()
+            self.logger.log({
+                "event": "shape_bucket",
+                "round": round_idx + 1,
+                "bucket_steps": int(steps),
+                "ladder_steps": [
+                    r * self.cfg.client.local_epochs
+                    for r in self._bucket_ladder
+                ],
+                "compiles": int(c1 - c0),
+                "compile_ms": round((s1 - s0) * 1000.0, 3),
+            })
+
+        return span()
+
+    def _host_inputs(self, round_idx: int, shape: Optional[RoundShape] = None):
         """All host-side work for one round: sampling, index construction,
         dropout weights, and (stream mode) the slab gather. Pure in
-        (seed, round) — safe to run ahead on a worker thread."""
+        (seed, round) — safe to run ahead on a worker thread.
+        ``shape`` overrides the round's grid (the fused chunk-max path);
+        default is the round's own bucket rung (or the legacy full
+        shape). Under ``_spec_inputs`` the third return slot carries the
+        [K, 2] mask SPEC instead of the full float32 mask."""
         if self.gossip and self._gossip_partial == 0:
             # full participation: row i of the round tensors IS client i
             # (the ring order is the client-id order, every round)
@@ -843,6 +960,8 @@ class Experiment:
             # centralized cohorts, or partial-participation gossip's
             # per-round active subset (uniform without replacement)
             cohort = self.sampler.sample(round_idx)
+        if shape is None:
+            shape = self._round_shape(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
         if self._native is not None:
             self._native.submit(round_idx, cohort)  # no-op if prefetched
@@ -857,10 +976,20 @@ class Experiment:
                 if nxt < self.cfg.server.num_rounds:
                     self._native.submit(nxt, self.sampler.sample(nxt))
             idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
+            if self._spec_inputs:
+                # the pipeline skipped the mask slab (build_mask=False);
+                # the spec is analytic — native packs each epoch's
+                # min(|shard|, cap) real indices contiguously
+                take = self._sizes_capped[np.asarray(cohort)]
+                mask = np.stack(
+                    [take, np.full(len(cohort), shape.steps, np.int64)], 1
+                ).astype(np.int32)
+        elif self._spec_inputs:
+            idx, mask, n_ex = make_round_spec(self.fed, cohort, shape, host_rng)
         else:
-            idx, mask, n_ex = make_round_indices(self.fed, cohort, self.shape, host_rng)
+            idx, mask, n_ex = make_round_indices(self.fed, cohort, shape, host_rng)
         mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng,
-                                          round_idx=round_idx)
+                                          round_idx=round_idx, shape=shape)
         if self._poisson:
             cap, b = self._poisson_cap, len(cohort)
             if b > cap:
@@ -890,13 +1019,22 @@ class Experiment:
         slab = self._stream_slab(idx) if self._stream else None
         return cohort, idx, mask, n_ex, slab
 
-    def _apply_failures(self, mask, n_ex, k, host_rng, round_idx=None):
+    def _apply_failures(self, mask, n_ex, k, host_rng, round_idx=None,
+                        shape=None):
         """Straggler truncation + dropout zeroing — shared by the sync
         cohort path and the async (fedbuff) scheduler. Realized counts
         are recorded per round for the telemetry counters (this runs on
-        the prefetch worker thread too; dict stores are atomic)."""
+        the prefetch worker thread too; dict stores are atomic).
+        ``mask`` is either the full [K, steps, batch] float mask or the
+        [K, 2] spec (``_spec_inputs``) — straggler truncation writes the
+        spec's valid-steps column and recomputes the weights through the
+        closed form ``spec_examples`` (exactly ``mask.sum((1, 2))`` of
+        the expanded mask), so both representations realize identical
+        failures from identical host draws."""
         if k == 0:
             return mask, n_ex  # empty poisson round: nothing to fail
+        shape = shape or self.shape
+        spec_mode = mask.ndim == 2  # [K, 2] spec vs [K, steps, batch]
         n_strag = n_drop = 0
         if self.cfg.server.straggler_rate > 0:
             # simulated stragglers (SURVEY.md §5, FedProx's motivating
@@ -908,11 +1046,15 @@ class Experiment:
             strag = host_rng.random(k) < self.cfg.server.straggler_rate
             if strag.any():
                 done = max(1, int(round(
-                    self.cfg.server.straggler_work * self.shape.steps
+                    self.cfg.server.straggler_work * shape.steps
                 )))
                 mask = mask.copy()
-                mask[strag, done:, :] = 0.0
-                n_ex = mask.sum((1, 2))
+                if spec_mode:
+                    mask[strag, 1] = np.minimum(mask[strag, 1], done)
+                    n_ex = spec_examples(mask, shape)
+                else:
+                    mask[strag, done:, :] = 0.0
+                    n_ex = mask.sum((1, 2))
                 n_strag = int(strag.sum())
         if self.cfg.server.dropout_rate > 0:
             # simulated client dropout (SURVEY.md §5): zero the FedAvg weight
@@ -939,12 +1081,16 @@ class Experiment:
             }
         return mask, n_ex
 
-    def _round_inputs(self, round_idx: int, place: bool = True):
+    def _round_inputs(self, round_idx: int, place: bool = True,
+                      shape: Optional[RoundShape] = None):
         """``place=False`` returns the idx/mask/n_ex tensors as HOST
         arrays (the fused-chunk path stacks `fuse` rounds of them and
         places the [F, ...] slabs once through the fused shardings —
         stacking already-placed global arrays would be an eager op on
-        non-addressable shards under multi-process)."""
+        non-addressable shards under multi-process). ``shape`` is the
+        fused chunk-max grid override; prefetch entries are keyed by
+        round with the bucket baked in (the bucket is a pure function
+        of the round, so worker and consumer agree)."""
         fut = self._prefetch.pop(round_idx, None)
         # the span measures the CRITICAL-PATH host-input cost: ~0 when
         # the prefetch worker ran ahead, the full build otherwise
@@ -952,7 +1098,9 @@ class Experiment:
             if fut is not None:
                 cohort, idx, mask, n_ex, slab = fut.result()
             else:
-                cohort, idx, mask, n_ex, slab = self._host_inputs(round_idx)
+                cohort, idx, mask, n_ex, slab = self._host_inputs(
+                    round_idx, shape=shape
+                )
         if self._stream and self._host_executor is None:
             # slab gathering is the heavy host work in stream mode; build
             # round r+1's slab on a worker thread while the device runs r
@@ -966,7 +1114,23 @@ class Experiment:
             self._prefetch[nxt] = self._host_executor.submit(self._host_inputs, nxt)
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._counters_on:
-            self._comm_stats[round_idx] = self._round_comm(cohort, n_host)
+            stats = self._round_comm(cohort, n_host)
+            # padded-shape accounting (r7): grid provenance, analytic
+            # host→device index-input bytes (the mask slab's removal is
+            # visible here), and the padded-step / wasted-FLOP gauges
+            rows, steps_g, batch_g = (
+                int(idx.shape[0]), int(idx.shape[1]), int(idx.shape[2])
+            )
+            stats["host_input_bytes"] = round_host_input_bytes(
+                rows, steps_g, batch_g, self._spec_inputs
+            )
+            if self._spec_inputs:
+                stats.update(round_shape_stats(
+                    mask, steps_g, batch_g, self.shape.local_epochs
+                ))
+                if self._bucket_ladder is not None:
+                    stats["shape_bucket_steps"] = steps_g
+            self._comm_stats[round_idx] = stats
         if not place:
             # fuse>1 requires hbm placement (validate), so slab is None
             return cohort, idx, mask, n_ex, self.train_x, self.train_y, n_host
@@ -979,7 +1143,12 @@ class Experiment:
                 train_x, train_y = self.train_x, self.train_y
             if self._cohort_sharding is not None:
                 idx = self._put(idx, self._cohort_sharding)
-                mask = self._put(mask, self._cohort_sharding)
+                # the [K, 2] spec has no batch dim — cohort-sharded only
+                mask = self._put(
+                    mask,
+                    self._client_sharding if self._spec_inputs
+                    else self._cohort_sharding,
+                )
                 n_ex = self._put(n_ex, self._client_sharding)
         return cohort, idx, mask, n_ex, train_x, train_y, n_host
 
@@ -1055,7 +1224,8 @@ class Experiment:
                 self.fed, cohort, self.shape, host_rng
             )
             mask, n_ex = self._apply_failures(mask, n_ex, k, host_rng,
-                                              round_idx=round_idx)
+                                              round_idx=round_idx,
+                                              shape=self.shape)
         if self._counters_on:
             self._comm_stats[round_idx] = self._round_comm(cohort, n_ex)
         base_w = (
@@ -1225,7 +1395,8 @@ class Experiment:
                     jnp.asarray(np.asarray(cohort, np.int32)),
                     self._data_sharding,
                 )
-                with self.tracer.span("round.dispatch"):
+                with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
+                        self.tracer.span("round.dispatch"):
                     out = round_fn(
                         *common, *glob, state["c_clients"], cohort_dev,
                     )
@@ -1243,7 +1414,8 @@ class Experiment:
                 c_cohort = jax.tree.map(
                     lambda a: jnp.asarray(a[safe]), state["c_clients"]
                 )
-                with self.tracer.span("round.dispatch"):
+                with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
+                        self.tracer.span("round.dispatch"):
                     out = round_fn(
                         *common, *(glob or (None,)), c_cohort,
                     )
@@ -1271,7 +1443,8 @@ class Experiment:
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
             with self.tracer.span("round.secagg_keys"):
                 kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
-        with self.tracer.span("round.dispatch"):
+        with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
+                self.tracer.span("round.dispatch"):
             params, opt_state, metrics = round_fn(
                 state["params"], state["server_opt_state"],
                 train_x, train_y, idx, mask, n_ex, rng, **kw,
@@ -1297,11 +1470,23 @@ class Experiment:
         attacks ride a stacked [F, K] byzantine-mask input; error
         feedback's store enters as the donated scan carry and comes
         back updated in place."""
+        # shape buckets compose with fusion at CHUNK granularity: the
+        # stacked [F, K, steps, batch] slab must be rectangular, so the
+        # chunk dispatches on the max of its sub-rounds' ladder rungs
+        # (monotone ladder pick ⇒ identical to picking for the chunk-max
+        # requirement). Padded steps are no-ops, so a sub-round riding a
+        # larger-than-its-own rung is still bitwise the same round.
+        chunk_shape = None
+        if self._bucket_ladder is not None:
+            chunk_shape = self._bucket_shape(max(
+                self._round_bucket_spe(round_idx + j) for j in range(fuse)
+            ))
         idxs, masks, n_exs, rngs, cohorts, byz_rows = [], [], [], [], [], []
         train_x = train_y = None
         for j in range(fuse):
             (c_j, i_j, m_j, n_j, train_x, train_y,
-             _) = self._round_inputs(round_idx + j, place=False)
+             _) = self._round_inputs(round_idx + j, place=False,
+                                     shape=chunk_shape)
             idxs.append(i_j)
             masks.append(m_j)
             n_exs.append(n_j)
@@ -1317,7 +1502,13 @@ class Experiment:
                     byz_rows.append(byz_h.astype(np.float32))
         with self.tracer.span("round.placement"):
             idx_f = self._put(np.stack(idxs), self._fused_cohort_sharding)
-            mask_f = self._put(np.stack(masks), self._fused_cohort_sharding)
+            # mask SPECS [F, K, 2] have no batch dim: fuse replicated,
+            # cohort over lanes — the per-client fused sharding
+            mask_f = self._put(
+                np.stack(masks),
+                self._fused_client_sharding if self._spec_inputs
+                else self._fused_cohort_sharding,
+            )
             n_ex_f = self._put(np.stack(n_exs), self._fused_client_sharding)
             # rng keys are tiny device scalars derived identically on
             # every process; stack on host (normalizing typed PRNG keys
@@ -1343,7 +1534,8 @@ class Experiment:
                 )
         common = (state["params"], state["server_opt_state"], train_x,
                   train_y, idx_f, mask_f, n_ex_f, rngs_f)
-        with self.tracer.span("round.dispatch", fuse=fuse):
+        with self._bucket_compile_span(round_idx, int(idx_f.shape[2])), \
+                self.tracer.span("round.dispatch", fuse=fuse):
             if self.ef:
                 params, opt_state, c_clients, metrics = self.round_fn(
                     *common, state["c_clients"], cohorts_f,
@@ -1555,6 +1747,16 @@ class Experiment:
                 # δ_abort term of the (ε, δ + δ_abort) guarantee for the
                 # aborting mechanism (see dp_client_epsilon)
                 "dp_delta_abort": float(self.dp_delta_abort()),
+            })
+        if start_round == 0 and self._bucket_ladder is not None:
+            # shape-bucket provenance: the ladder every round's grid is
+            # drawn from (rungs in steps_per_epoch), plus the bound the
+            # compile budget is asserted against
+            self.logger.log({
+                "event": "shape_buckets",
+                "ladder": [int(r) for r in self._bucket_ladder],
+                "full_steps_per_epoch": int(self.shape.steps_per_epoch),
+                "max_compiles_per_engine": len(self._bucket_ladder),
             })
         if start_round == 0 and self.attack_kind:
             # attack provenance: everything needed to attribute a run's

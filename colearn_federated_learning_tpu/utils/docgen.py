@@ -23,6 +23,15 @@ _SECTIONS = [
      "Byzantine adversary simulation (in-loop attack injection)."),
     ("run", config_mod.RunConfig,
      "Engine/mesh/dtype/ops switches (profiling, retries, host pipeline)."),
+    ("run.shape_buckets", config_mod.ShapeBucketsConfig,
+     "Heterogeneity-aware round shapes: quantize each round's step grid "
+     "onto a geometric ladder sized by the SAMPLED cohort (chunk-max "
+     "under run.fuse_rounds) instead of the federation max. Padded "
+     "steps are exact no-ops, so bucketed runs are bitwise-equal to "
+     "buckets-off runs on the same seed and host pipeline, with <= "
+     "ladder-size extra compiles per engine (attributed per rung via "
+     "the obs compile listener's `shape_bucket` events). See "
+     "docs/DESIGN.md \"Shape buckets & retrace policy\"."),
     ("run.obs", config_mod.ObsConfig,
      "Observability: round-lifecycle phase spans (+ optional Chrome-trace "
      "export), communication/device counters, and NaN/divergence health "

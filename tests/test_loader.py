@@ -1,12 +1,17 @@
 import numpy as np
+import pytest
 
 from colearn_federated_learning_tpu.config import ClientConfig, DataConfig
 from colearn_federated_learning_tpu.data import build_federated_data
 from colearn_federated_learning_tpu.data.loader import (
     RoundShape,
+    _make_round_spec_loop,
     compute_round_shape,
     eval_batches,
     make_round_indices,
+    make_round_spec,
+    mask_from_spec,
+    spec_examples,
 )
 
 
@@ -49,9 +54,130 @@ def test_round_indices_cover_each_epoch():
         np.testing.assert_array_equal(np.sort(seen), np.arange(10, 20))
 
 
+class _Fed:
+    def __init__(self, client_indices):
+        self.client_indices = client_indices
+
+
+def _hetero_fed(seed=3, n_clients=6):
+    """Heterogeneous shards, including one exceeding the cap and one
+    empty — the shapes the vectorized builder has to get right."""
+    rng = np.random.default_rng(seed)
+    shards = [
+        rng.permutation(np.arange(i * 50, i * 50 + s))
+        for i, s in enumerate(rng.integers(0, 40, n_clients))
+    ]
+    shards[0] = np.arange(300, 345)  # > cap: subsampling path
+    shards[-1] = np.zeros(0, np.int64)  # empty shard
+    return _Fed(shards)
+
+
+def test_vectorized_spec_equals_loop_reference():
+    """The batched argsort/scatter builder must equal the per-row loop
+    twin exactly — same seed, same draws, same packing (the satellite's
+    output-equality pin)."""
+    fed = _hetero_fed()
+    shape = RoundShape(local_epochs=3, steps_per_epoch=5, batch_size=8, cap=32)
+    for seed in (0, 1, 17):
+        r1, r2 = np.random.default_rng(seed), np.random.default_rng(seed)
+        cohort = [0, 2, 4, 5]
+        idx_v, spec_v, n_v = make_round_spec(fed, cohort, shape, r1)
+        idx_l, spec_l, n_l = _make_round_spec_loop(fed, cohort, shape, r2)
+        np.testing.assert_array_equal(idx_v, idx_l)
+        np.testing.assert_array_equal(spec_v, spec_l)
+        np.testing.assert_array_equal(n_v, n_l)
+
+
+def test_spec_grid_independence():
+    """The random draws depend only on the cohort's shard lengths and
+    the cap — a bucketed (smaller-steps) grid packs the SAME example
+    order, just with fewer trailing pad steps (the shape-bucket bitwise
+    contract at the loader level)."""
+    fed = _hetero_fed()
+    cohort = [1, 2, 3]
+    full = RoundShape(local_epochs=2, steps_per_epoch=6, batch_size=8, cap=40)
+    small = RoundShape(local_epochs=2, steps_per_epoch=5, batch_size=8, cap=40)
+    idx_f, spec_f, n_f = make_round_spec(
+        fed, cohort, full, np.random.default_rng(9))
+    idx_s, spec_s, n_s = make_round_spec(
+        fed, cohort, small, np.random.default_rng(9))
+    np.testing.assert_array_equal(spec_f[:, 0], spec_s[:, 0])
+    np.testing.assert_array_equal(n_f, n_s)
+    for row in range(len(cohort)):
+        for e in range(2):
+            a = idx_f.reshape(len(cohort), 2, -1)[row, e]
+            b = idx_s.reshape(len(cohort), 2, -1)[row, e]
+            n = int(spec_f[row, 0])
+            np.testing.assert_array_equal(a[:n], b[:n])
+            assert not a[n:].any() and not b[n:].any()
+
+
+def test_spec_too_small_grid_raises():
+    fed = _Fed([np.arange(20)])
+    shape = RoundShape(local_epochs=1, steps_per_epoch=2, batch_size=8, cap=20)
+    with pytest.raises(ValueError, match="too small"):
+        make_round_spec(fed, [0], shape, np.random.default_rng(0))
+
+
+def test_mask_from_spec_matches_legacy_mask():
+    fed = _hetero_fed()
+    shape = RoundShape(local_epochs=2, steps_per_epoch=4, batch_size=8, cap=24)
+    r1, r2 = np.random.default_rng(4), np.random.default_rng(4)
+    _, mask, _ = make_round_indices(fed, [0, 1, 2], shape, r1)
+    _, spec, _ = make_round_spec(fed, [0, 1, 2], shape, r2)
+    np.testing.assert_array_equal(mask, mask_from_spec(spec, shape))
+
+
+def test_on_device_mask_matches_numpy_expansion():
+    """The engines' broadcasted_iota reconstruction must equal the
+    NumPy expansion bit-for-bit, including straggler-truncated specs."""
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        _mask_from_spec,
+    )
+
+    shape = RoundShape(local_epochs=2, steps_per_epoch=3, batch_size=4, cap=12)
+    spec = np.array([[12, 6], [5, 6], [7, 2], [0, 6]], np.int32)
+    want = mask_from_spec(spec, shape)
+    got = np.asarray(_mask_from_spec(
+        jnp.asarray(spec), shape.steps, shape.batch_size,
+        shape.local_epochs, shape.batch_size, 0,
+    ))
+    np.testing.assert_array_equal(want, got)
+    # batch-sharded halves agree with the unsharded mask's columns
+    half = shape.batch_size // 2
+    lo = np.asarray(_mask_from_spec(
+        jnp.asarray(spec), shape.steps, half, shape.local_epochs,
+        shape.batch_size, 0,
+    ))
+    hi = np.asarray(_mask_from_spec(
+        jnp.asarray(spec), shape.steps, half, shape.local_epochs,
+        shape.batch_size, half,
+    ))
+    np.testing.assert_array_equal(want, np.concatenate([lo, hi], axis=2))
+
+
+def test_spec_examples_closed_form():
+    shape = RoundShape(local_epochs=3, steps_per_epoch=4, batch_size=8, cap=30)
+    spec = np.array(
+        [[30, 12], [30, 5], [9, 12], [9, 3], [0, 12]], np.int32
+    )
+    np.testing.assert_array_equal(
+        spec_examples(spec, shape), mask_from_spec(spec, shape).sum((1, 2))
+    )
+
+
 def test_eval_batches_padding():
     x = np.arange(10, dtype=np.float32).reshape(10, 1)
     y = np.arange(10, dtype=np.int32)
     xb, yb, mb = eval_batches(x, y, 4)
     assert xb.shape == (3, 4, 1)
     assert mb.sum() == 10
+
+
+def test_eval_batches_empty_raises():
+    """Regression: n == 0 used to index x[:1] of an empty array deep in
+    np.repeat; now it fails with the actual cause."""
+    with pytest.raises(ValueError, match="at least one example"):
+        eval_batches(np.zeros((0, 3), np.float32), np.zeros((0,), np.int32), 4)

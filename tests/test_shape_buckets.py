@@ -1,0 +1,360 @@
+"""Heterogeneity-aware round shapes (run.shape_buckets, r7).
+
+The core invariant: padded steps are exact algebraic no-ops, so a
+bucketed run — whose per-round grid is quantized to the sampled
+cohort's requirement instead of the federation max — must be
+BITWISE-EQUAL to the buckets-off run on the same seed and host
+pipeline, across engines, aggregators, attacks, error feedback, fusion,
+and resume. The compile budget is bounded by the ladder size and
+attributed per rung via the obs compile listener.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.data.loader import (
+    bucket_ladder,
+    pick_bucket,
+)
+from colearn_federated_learning_tpu.obs.counters import (
+    round_host_input_bytes,
+    round_shape_stats,
+)
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _params_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)),
+        a, b,
+    )
+
+
+def _cfg(buckets, engine="sharded", fuse=1, rounds=4, seed=0, out="",
+         resume=False, ckpt=0, **over):
+    """Tiny Dirichlet federation with genuinely heterogeneous shards so
+    the ladder has multiple realizable rungs (pipeline pinned to numpy:
+    buckets force it, and the bitwise contract is per pipeline kind)."""
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 8
+    cfg.data.partition = "dirichlet"
+    cfg.data.dirichlet_alpha = 0.3
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    cfg.client.batch_size = 8
+    cfg.server.cohort_size = 2
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.server.checkpoint_every = ckpt
+    cfg.run.seed = seed
+    cfg.run.out_dir = out
+    cfg.run.resume = resume
+    cfg.run.engine = engine
+    cfg.run.fuse_rounds = fuse
+    cfg.run.host_pipeline = "numpy"
+    cfg.run.metrics_flush_every = 1
+    cfg.run.shape_buckets.enabled = buckets
+    cfg.run.shape_buckets.base = 2.0
+    cfg.run.shape_buckets.count = 3
+    for k, v in over.items():
+        cfg.apply_overrides({k: v})
+    return cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# ladder math
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_shape():
+    assert bucket_ladder(13, 2.0, 4) == [2, 4, 7, 13]
+    assert bucket_ladder(13, 2.0, 1) == [13]
+    assert bucket_ladder(1, 2.0, 4) == [1]  # floors at 1, deduplicated
+    # top rung is always the full shape even when base^count overshoots
+    assert bucket_ladder(5, 10.0, 3) == [1, 5]
+
+
+def test_bucket_ladder_rejects_bad_params():
+    with pytest.raises(ValueError, match="base"):
+        bucket_ladder(8, 1.0, 3)
+    with pytest.raises(ValueError, match="count"):
+        bucket_ladder(8, 2.0, 0)
+
+
+def test_pick_bucket_smallest_covering_rung():
+    ladder = [2, 4, 7, 13]
+    assert pick_bucket(1, ladder) == 2
+    assert pick_bucket(2, ladder) == 2
+    assert pick_bucket(3, ladder) == 4
+    assert pick_bucket(7, ladder) == 7
+    assert pick_bucket(13, ladder) == 13
+    with pytest.raises(ValueError, match="no ladder rung"):
+        pick_bucket(14, ladder)
+    # monotone: chunk-max of picks == pick of chunk-max (the fused
+    # chunk selection identity the driver relies on)
+    needs = [1, 5, 3, 2]
+    assert max(pick_bucket(n, ladder) for n in needs) == pick_bucket(
+        max(needs), ladder
+    )
+
+
+# ---------------------------------------------------------------------------
+# the analytic counter models
+# ---------------------------------------------------------------------------
+
+
+def test_host_input_bytes_drop_is_the_mask_slab():
+    """Acceptance pin: the on-device-mask wire model drops exactly the
+    removed [K, steps, batch] float32 slab (minus the [K, 2] spec that
+    replaced it)."""
+    k, steps, batch = 16, 12, 32
+    legacy = round_host_input_bytes(k, steps, batch, on_device_mask=False)
+    spec = round_host_input_bytes(k, steps, batch, on_device_mask=True)
+    assert legacy - spec == k * steps * batch * 4 - k * 2 * 4
+    # and the spec model is idx + spec + n_ex exactly
+    assert spec == k * steps * batch * 4 + k * 2 * 4 + k * 4
+
+
+def test_round_shape_stats_gauges():
+    # 2 clients on a 4-step/batch-4 grid (1 epoch): 5 and 0 examples
+    spec = np.array([[5, 4], [0, 4]], np.int32)
+    stats = round_shape_stats(spec, steps=4, batch=4, local_epochs=1)
+    # real steps: ceil(5/4)=2 of 8 grid steps → 6/8 padded
+    assert stats["padded_step_fraction"] == 0.75
+    # real examples: 5 of 32 grid positions
+    assert stats["padded_example_fraction"] == round(1 - 5 / 32, 4)
+    # straggler truncation (valid_steps) shrinks the real share
+    spec_t = np.array([[5, 1], [0, 4]], np.int32)
+    stats_t = round_shape_stats(spec_t, steps=4, batch=4, local_epochs=1)
+    assert stats_t["padded_step_fraction"] == round(1 - 1 / 8, 4)
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: bucketed == buckets-off
+# ---------------------------------------------------------------------------
+
+
+class TestBucketedBitwiseParity:
+    @pytest.mark.parametrize("engine", ["sharded", "sequential"])
+    def test_plain_fedavg(self, engine):
+        off = Experiment(_cfg(False, engine), echo=False).fit()
+        exp = Experiment(_cfg(True, engine), echo=False)
+        on = exp.fit()
+        _params_equal(off["params"], on["params"])
+        # the run must have actually exercised a trimmed grid — a
+        # parity test that only ever realized the full rung proves
+        # nothing about bucketing
+        assert min(exp._seen_buckets) < exp.shape.steps
+
+    @pytest.mark.parametrize("over", [
+        {"server.aggregator": "median"},
+        {"server.aggregator": "krum", "server.krum_byzantine": 0,
+         "server.cohort_size": 4},
+        {"attack.kind": "sign_flip", "attack.fraction": 0.25,
+         "server.aggregator": "median"},
+        {"attack.kind": "sign_flip", "attack.fraction": 0.25},
+        {"server.compression": "qsgd", "server.error_feedback": True},
+    ], ids=["median", "krum", "median+sign_flip", "mean+sign_flip", "ef"])
+    def test_aggregator_attack_ef_variants(self, over):
+        off = Experiment(_cfg(False, **over), echo=False).fit()
+        on = Experiment(_cfg(True, **over), echo=False).fit()
+        _params_equal(off["params"], on["params"])
+        if "c_clients" in off:
+            _params_equal(off["c_clients"], on["c_clients"])
+
+    def test_fused_chunk_max_selection(self):
+        """fuse=2 chunks dispatch on the chunk-max rung: every fused
+        sub-round's grid is the max of its rounds' per-round picks, and
+        the result still matches the unfused buckets-off run bitwise."""
+        off = Experiment(_cfg(False, fuse=1), echo=False).fit()
+        exp = Experiment(_cfg(True, fuse=2), echo=False)
+        on = exp.fit()
+        _params_equal(off["params"], on["params"])
+        fuse, epochs = 2, exp.cfg.client.local_epochs
+        by_round = {
+            r["round"] - 1: r["shape_bucket_steps"]
+            for r in exp.logger.history if "shape_bucket_steps" in r
+        }
+        assert sorted(by_round) == [0, 1, 2, 3]
+        for chunk_start in range(0, 4, fuse):
+            chunk_steps = max(
+                exp._round_bucket_spe(chunk_start + j) for j in range(fuse)
+            ) * epochs
+            for j in range(fuse):
+                # every sub-round of the chunk dispatched on the
+                # chunk-max rung (rectangular [F, ...] slab)
+                assert by_round[chunk_start + j] == chunk_steps
+
+    def test_fused_equals_unfused_both_bucketed(self):
+        a = Experiment(_cfg(True, fuse=1), echo=False).fit()
+        b = Experiment(_cfg(True, fuse=2), echo=False).fit()
+        _params_equal(a["params"], b["params"])
+
+    def test_unaligned_resume_through_bucket_boundary(self, tmp_path):
+        """PR 3's fuse=1 catch-up twin × buckets: a checkpoint at a
+        non-chunk-aligned round resumes through unfused catch-up rounds
+        (per-ROUND rungs) into the fused loop (chunk-max rungs) and
+        still lands bitwise on the straight bucketed run — bucket
+        choice affects padding only, never math."""
+        Experiment(
+            _cfg(True, rounds=3, out=str(tmp_path), ckpt=1), echo=False
+        ).fit()
+        exp = Experiment(
+            _cfg(True, rounds=6, fuse=2, out=str(tmp_path), resume=True,
+                 ckpt=2),
+            echo=False,
+        )
+        resumed = exp.fit()
+        assert int(resumed["round"]) == 6
+        warns = [r for r in exp.logger.history
+                 if r.get("warning") == "fuse_unaligned_resume"]
+        assert len(warns) == 1
+        straight = Experiment(
+            _cfg(True, rounds=6, out=str(tmp_path / "straight")), echo=False
+        ).fit()
+        _params_equal(straight["params"], resumed["params"])
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: gauges + compile budget (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _compile_count(exp):
+    return sum(
+        r["phases"]["compile"]["count"]
+        for r in exp.logger.history
+        if r.get("event") == "spans" and "compile" in r.get("phases", {})
+    )
+
+
+def test_smoke_bucketed_run_gauges_and_compile_budget():
+    """Tier-1 smoke for the whole feature: a tiny Dirichlet config with
+    buckets on must (a) log the ladder provenance event, (b) report a
+    LOWER mean padded_step_fraction than the buckets-off run on the
+    same seed, (c) stay within the ladder-size compile budget, with
+    per-rung attribution events, and (d) show the mask-slab drop in the
+    analytic host_input_bytes."""
+    exp_off = Experiment(_cfg(False, rounds=4), echo=False)
+    off_state = exp_off.fit()
+    exp_on = Experiment(_cfg(True, rounds=4), echo=False)
+    on_state = exp_on.fit()
+    _params_equal(off_state["params"], on_state["params"])
+
+    def recs(exp):
+        return [r for r in exp.logger.history if "train_loss" in r]
+
+    # (a) ladder provenance
+    prov = [r for r in exp_on.logger.history
+            if r.get("event") == "shape_buckets"]
+    assert len(prov) == 1
+    ladder = prov[0]["ladder"]
+    assert prov[0]["max_compiles_per_engine"] == len(ladder)
+    # (b) the padded-step gauge drops on the same seed
+    off_frac = np.mean([r["padded_step_fraction"] for r in recs(exp_off)])
+    on_frac = np.mean([r["padded_step_fraction"] for r in recs(exp_on)])
+    assert on_frac < off_frac
+    # every bucketed round's grid is a ladder rung
+    epochs = exp_on.cfg.client.local_epochs
+    rung_steps = {r * epochs for r in ladder}
+    assert all(r["shape_bucket_steps"] in rung_steps for r in recs(exp_on))
+    # (c) compile budget: the bucketed run may retrace at most
+    # ladder-size-1 times beyond the buckets-off run (which compiles
+    # the full rung once), and each newly-realized rung is attributed
+    assert _compile_count(exp_on) <= _compile_count(exp_off) + len(ladder) - 1
+    events = [r for r in exp_on.logger.history
+              if r.get("event") == "shape_bucket"]
+    assert {e["bucket_steps"] for e in events} == exp_on._seen_buckets
+    assert 1 <= len(events) <= len(ladder)
+    # (d) wire bytes: every record reflects the spec model — the mask
+    # slab's bytes are gone from the analytic host-input accounting
+    for r in recs(exp_on):
+        steps = r["shape_bucket_steps"]
+        k = exp_on.cfg.server.cohort_size
+        batch = exp_on.cfg.client.batch_size
+        assert r["host_input_bytes"] == round_host_input_bytes(
+            k, steps, batch, on_device_mask=True
+        )
+
+
+def test_straggler_spec_truncation_matches_mask_path():
+    """Stragglers on the spec path (buckets OFF — the pairing is
+    rejected under buckets): the valid-steps column truncation must
+    realize the same weights the legacy mask-tail zeroing did. The
+    sequential and sharded engines agreeing across a straggler run is
+    the end-to-end witness."""
+    over = {"server.straggler_rate": 0.5, "server.straggler_work": 0.4}
+    a = Experiment(_cfg(False, "sharded", **over), echo=False).fit()
+    b = Experiment(_cfg(False, "sequential", **over), echo=False).fit()
+    # engines agree bitwise on the identical spec inputs
+    tol = dict(rtol=2e-5, atol=1e-6)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), **tol),
+        a["params"], b["params"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+
+class TestValidation:
+    def _base(self):
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.shape_buckets.enabled = True
+        return cfg
+
+    def test_rejects_bad_ladder_params(self):
+        cfg = self._base()
+        cfg.run.shape_buckets.base = 1.0
+        with pytest.raises(ValueError, match="base"):
+            cfg.validate()
+        cfg = self._base()
+        cfg.run.shape_buckets.count = 0
+        with pytest.raises(ValueError, match="count"):
+            cfg.validate()
+
+    def test_rejects_example_dp(self):
+        cfg = self._base()
+        cfg.dp.enabled = True
+        with pytest.raises(ValueError, match="dp.enabled"):
+            cfg.validate()
+
+    def test_rejects_stragglers(self):
+        cfg = self._base()
+        cfg.server.straggler_rate = 0.1
+        with pytest.raises(ValueError, match="straggler"):
+            cfg.validate()
+
+    def test_rejects_native_pipeline(self):
+        cfg = self._base()
+        cfg.run.host_pipeline = "native"
+        with pytest.raises(ValueError, match="native"):
+            cfg.validate()
+
+    def test_rejects_fedbuff_and_gossip(self):
+        for algo in ("fedbuff", "gossip"):
+            cfg = self._base()
+            cfg.algorithm = algo
+            with pytest.raises(ValueError, match="sampled cohort"):
+                cfg.validate()
+
+    def test_accepts_fusion_robust_attack_ef_and_buckets(self):
+        cfg = self._base()
+        cfg.data.num_clients = 8
+        cfg.server.cohort_size = 4
+        cfg.server.num_rounds = 4
+        cfg.server.eval_every = 2
+        cfg.run.fuse_rounds = 2
+        cfg.server.aggregator = "median"
+        cfg.attack.kind = "sign_flip"
+        cfg.validate()
+        cfg = self._base()
+        cfg.server.compression = "qsgd"
+        cfg.server.error_feedback = True
+        cfg.validate()
