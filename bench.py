@@ -333,6 +333,10 @@ def bench_config(name: str):
         # interpretable across shape re-pins
         "fuse_rounds": cfg.run.fuse_rounds,
         "local_param_dtype": cfg.run.local_param_dtype,
+        # the per-client forensic ledger adds an in-program stats block
+        # + scatter to every round — throughput numbers with it on are
+        # not comparable to ledger-off pins, so record the switch
+        "client_ledger": bool(cfg.run.obs.client_ledger.enabled),
     }
     for k, v in overrides.items():
         extra[f"override:{k}"] = v

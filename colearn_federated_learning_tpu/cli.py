@@ -7,6 +7,8 @@ Entry points with capability parity to the reference's
     colearn evaluate --config cifar10_fedavg_100
     colearn configs            # list the named BASELINE configs
     colearn summarize <run>    # per-phase timing table from a run's JSONL
+    colearn clients <run>      # per-client forensic ledger report
+                               # (anomalies + attack precision/recall)
 
 ``--config`` accepts a registry name or a YAML path; ``--set a.b=v``
 overrides any field. ``fit --resume`` continues from the latest
@@ -106,6 +108,27 @@ def build_parser():
     sm.add_argument("--json", action="store_true",
                     help="emit the aggregated summary as one JSON object "
                          "instead of the table")
+
+    cl = sub.add_parser(
+        "clients",
+        help="per-client forensic ledger report: top-k anomalous "
+             "clients, participation histogram, and attack-detection "
+             "precision/recall (requires run.obs.client_ledger; no "
+             "backend needed)",
+    )
+    cl.add_argument("run", metavar="RUN",
+                    help="run name (looked up under --out-dir), a run "
+                         "directory, or a .metrics.jsonl path")
+    cl.add_argument("--out-dir", default="runs",
+                    help="where <RUN>.metrics.jsonl lives (default: runs)")
+    cl.add_argument("--top", type=int, default=10,
+                    help="how many anomalous clients to list")
+    cl.add_argument("--min-flag-rate", type=float, default=0.5,
+                    help="fraction of a client's participations that "
+                         "must be flagged to count as detected")
+    cl.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object instead of "
+                         "the table")
     return p
 
 
@@ -120,7 +143,7 @@ def main(argv=None):
             print(name)
         return 0
 
-    if args.cmd == "summarize":
+    if args.cmd in ("summarize", "clients"):
         # pure-host JSONL aggregation — runs before (and without) any
         # jax backend initialization
         from colearn_federated_learning_tpu.obs import summary as obs_summary
@@ -130,7 +153,29 @@ def main(argv=None):
         except FileNotFoundError as e:
             print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
             return 2
-        agg = obs_summary.summarize_records(obs_summary.load_records(path))
+        records = obs_summary.load_records(path)
+        if not records:
+            # an empty (or torn-to-nothing) log gets a clean error, not
+            # a zero-row table or a traceback
+            print(f"error: no metrics records in {path}", file=sys.stderr)
+            return 2
+        if args.cmd == "clients":
+            from colearn_federated_learning_tpu.obs import ledger as obs_ledger
+
+            try:
+                report = obs_ledger.clients_report(
+                    records, top_k=args.top,
+                    min_flag_rate=args.min_flag_rate,
+                )
+            except ValueError as e:
+                print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(dict(report, path=path)))
+            else:
+                print(obs_ledger.format_clients_report(report, path))
+            return 0
+        agg = obs_summary.summarize_records(records)
         if args.json:
             print(json.dumps(dict(agg, path=path)))
         else:

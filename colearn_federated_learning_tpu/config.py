@@ -343,6 +343,48 @@ class AttackConfig:
 
 
 @dataclass
+class ClientLedgerConfig:
+    """Per-client forensic ledger (``run.obs.client_ledger``,
+    obs/ledger.py): each round program additionally emits a small
+    ``[K]``-shaped per-client stats block (upload L2 norm, cosine
+    similarity to the aggregated delta, clip/EF residual magnitude,
+    post-local-train loss, and a robust median/MAD z-score anomaly
+    flag) and scatters it IN-PROGRAM into a device-resident
+    ``[num_clients]`` ledger carried across rounds (participation
+    count, EMA of each stat, cumulative flagged rounds) — zero extra
+    host round-trips, riding the fused scan carry under
+    ``run.fuse_rounds`` exactly like the EF residual store. The ledger
+    flows out as periodic ``client_ledger`` JSONL records (plus a
+    final one on every exit path, aborts included) and powers the
+    ``colearn clients <run>`` report: top-k anomalous clients,
+    participation histogram, and — when ``attack.kind`` is set —
+    detection precision/recall against the ground-truth compromised
+    set. Aggregation itself is untouched: a ledger-on run's params
+    trajectory is bitwise identical to the same run with the ledger
+    off (the stats block reads the upload stack; it never feeds back).
+
+    Rejected pairings (validate(), with reasons): secure_aggregation
+    (per-client uploads are exactly what masking hides), client-level
+    DP (a per-client statistics channel voids the client-DP release),
+    gossip/fedbuff (no synchronous cohort upload stack), and
+    scaffold/feddyn (their store plumbing owns the per-client state
+    path; robust/attack forensics is rejected there anyway)."""
+
+    enabled: bool = False
+    # EMA coefficient for the per-stat running means: ema_x moves by
+    # ema*(x - ema_x) per observed round; a client's first observation
+    # seeds the EMA with the value itself
+    ema: float = 0.2
+    # robust z-score threshold: a participant whose max(z_l2, z_cos)
+    # exceeds this is flagged for the round (3.5 is the classic
+    # median/MAD outlier cutoff)
+    zmax: float = 3.5
+    # rounds between periodic client_ledger JSONL snapshots (emitted at
+    # metrics-flush boundaries); 0 = only the end-of-fit/abort record
+    log_every: int = 0
+
+
+@dataclass
 class ObsConfig:
     """Round-lifecycle telemetry (``obs/``): phase spans, comm/device
     counters, and run-health monitoring — the observability layer every
@@ -357,6 +399,12 @@ class ObsConfig:
     # <out_dir>/<name>/trace.json at the end of fit (open in
     # ui.perfetto.dev or chrome://tracing). Requires spans.
     trace: bool = False
+    # Cap on accumulated Chrome-trace events: long runs otherwise
+    # silently produce multi-GB trace.json files. When the cap is hit
+    # the tracer warns ONCE and drops further events (per-phase span
+    # aggregates are unaffected); the export also warns once when the
+    # written file exceeds the size threshold. 0 = unbounded.
+    trace_max_events: int = 1_000_000
     # Per-round communication byte counters (analytic wire model:
     # upload/download, pre/post compression — obs/counters.py) merged
     # into each round's JSONL record.
@@ -380,6 +428,10 @@ class ObsConfig:
     #                      run.max_retries: a NaN run re-NaNs)
     #   checkpoint_abort — save a post-mortem checkpoint first
     on_unhealthy: str = "warn"  # warn | abort | checkpoint_abort
+    # Per-client forensic ledger — see ClientLedgerConfig.
+    client_ledger: ClientLedgerConfig = field(
+        default_factory=ClientLedgerConfig
+    )
 
 
 @dataclass
@@ -1192,6 +1244,57 @@ class ExperimentConfig:
                 "run.obs.trace=true requires run.obs.spans=true (the "
                 "trace is built from the spans)"
             )
+        if obs.trace_max_events < 0:
+            raise ValueError(
+                f"run.obs.trace_max_events must be >= 0, "
+                f"got {obs.trace_max_events}"
+            )
+        cl = obs.client_ledger
+        if not 0.0 < cl.ema <= 1.0:
+            raise ValueError(
+                f"run.obs.client_ledger.ema must be in (0, 1], got {cl.ema}"
+            )
+        if cl.zmax <= 0.0:
+            raise ValueError(
+                f"run.obs.client_ledger.zmax must be > 0, got {cl.zmax}"
+            )
+        if cl.log_every < 0:
+            raise ValueError(
+                f"run.obs.client_ledger.log_every must be >= 0, "
+                f"got {cl.log_every}"
+            )
+        if cl.enabled:
+            if self.server.secure_aggregation:
+                # the ledger computes per-client upload statistics —
+                # exactly the information secure aggregation exists to
+                # hide from the server
+                raise ValueError(
+                    "run.obs.client_ledger is incompatible with "
+                    "secure_aggregation (per-client upload statistics "
+                    "are what masking hides)"
+                )
+            if self.server.dp_client_noise_multiplier > 0.0:
+                # client-level DP releases only the noised aggregate;
+                # a per-client statistics side channel voids it
+                raise ValueError(
+                    "run.obs.client_ledger is incompatible with "
+                    "client-level DP (per-client statistics are a "
+                    "disclosure channel the DP analysis does not cover)"
+                )
+            if self.algorithm in ("gossip", "fedbuff"):
+                raise ValueError(
+                    f"run.obs.client_ledger is incompatible with "
+                    f"algorithm={self.algorithm!r} (no synchronous "
+                    f"cohort upload stack to compute stats over)"
+                )
+            if self.algorithm in ("scaffold", "feddyn"):
+                raise ValueError(
+                    f"run.obs.client_ledger is incompatible with "
+                    f"algorithm={self.algorithm!r} (the stateful "
+                    f"engines own the per-client state path; the "
+                    f"attack/robust stacks the ledger audits are "
+                    f"rejected there anyway)"
+                )
         return self
 
     # ---- serialization ------------------------------------------------
@@ -1224,6 +1327,7 @@ class ExperimentConfig:
             "run": RunConfig,
             "obs": ObsConfig,  # nested under run
             "shape_buckets": ShapeBucketsConfig,  # nested under run
+            "client_ledger": ClientLedgerConfig,  # nested under run.obs
         }
         return build(cls, d)
 

@@ -11,6 +11,11 @@ Three pillars, each its own module, all host-side and engine-agnostic:
   compression, uplink + downlink) and device-memory polling.
 - :mod:`health` — NaN/Inf + divergence monitoring over the per-round
   loss with configurable abort / checkpoint-and-abort actions.
+- :mod:`ledger` — the per-client forensic ledger
+  (``run.obs.client_ledger``): in-program cohort statistics + anomaly
+  flags scattered into a device-resident per-client store, periodic
+  ``client_ledger`` JSONL records, and the ``colearn clients``
+  attack-attribution report.
 
 Everything is configured through the ``run.obs`` config block
 (:class:`~colearn_federated_learning_tpu.config.ObsConfig`); the
@@ -28,5 +33,12 @@ from colearn_federated_learning_tpu.obs.counters import (  # noqa: F401
 from colearn_federated_learning_tpu.obs.health import (  # noqa: F401
     HealthAbortError,
     HealthMonitor,
+)
+from colearn_federated_learning_tpu.obs.ledger import (  # noqa: F401
+    LEDGER_COLS,
+    LEDGER_WIDTH,
+    STAT_COLS,
+    client_round_stats,
+    update_ledger,
 )
 from colearn_federated_learning_tpu.obs.spans import Tracer  # noqa: F401

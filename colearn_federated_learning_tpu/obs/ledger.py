@@ -1,0 +1,338 @@
+"""Per-client forensic ledger: in-program client statistics, anomaly
+scoring, and attack-attribution reporting (``run.obs.client_ledger``).
+
+PR 2 gave the system run-level observability and PR 1/3 a Byzantine
+attack + robust-aggregation stack, but nothing could answer *which
+client* did what. This module is the client-level accounting layer
+(FedScale's per-client traces / Oort's utility scores are the lineage):
+
+- **In-program round stats** (:func:`client_round_stats`): each round
+  program additionally computes a small ``[K, NSTATS]`` block over the
+  cohort's wire uploads — update L2 norm, cosine similarity to the
+  aggregated delta, clip/EF residual magnitude, post-local-train loss,
+  and a robust z-score (median/MAD over the participating cohort) with
+  its threshold flag. Computed AFTER the attack transform (forensics
+  sees the messages the server sees) and shared verbatim by the
+  sharded engine (under jit, on the client-sharded stack), the
+  sequential oracle, and the fused scan body — one implementation is
+  the parity argument, exactly like ``apply_upload_attack``.
+- **The ledger** (:func:`update_ledger`): a device-resident
+  ``[num_clients, LEDGER_WIDTH]`` float32 store carried across rounds
+  (participation count, cumulative flagged-rounds count, EMA of each
+  stat), scattered in-program from the round's stats block — zero
+  extra host round-trips, riding the fused ``lax.scan`` carry under
+  ``run.fuse_rounds`` exactly like the EF residual store. Poisson pad
+  slots (id == num_clients) and dropped clients route to an
+  out-of-bounds row and are dropped by the scatter.
+- **Reporting** (:func:`clients_report` / :func:`format_clients_report`):
+  pure-host aggregation of the driver's periodic ``client_ledger``
+  JSONL records into the ``colearn clients <run>`` report — top-k
+  anomalous clients, participation histogram, and (when the run had
+  ``attack.kind`` set) detection precision/recall of the anomaly flag
+  against the ground-truth compromised set the ``attack`` provenance
+  event recorded.
+
+The jax-dependent functions import jax lazily so the CLI report path
+(like ``obs/summary.py``) stays importable without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# per-round stats block columns ([K, NSTATS], float32; flag is 0/1)
+STAT_COLS = ("l2", "cos", "resid", "loss", "z", "flag")
+NSTATS = len(STAT_COLS)
+# ledger store columns ([num_clients, LEDGER_WIDTH], float32)
+LEDGER_COLS = (
+    "count", "flagged", "ema_l2", "ema_cos", "ema_resid", "ema_loss",
+    "ema_z",
+)
+LEDGER_WIDTH = len(LEDGER_COLS)
+
+
+def upload_residual(pre_block, upload_block):
+    """Per-client L2 norm of (what the client computed − what it
+    shipped) over a ``[width, ...]`` block pair: the clip residual
+    (raw Δ vs clipped/compressed upload) on the plain path, exactly
+    ``‖eᵢ⁺‖`` under error feedback (pre = Δ+e, upload = C(Δ+e)).
+    Shared by the sharded lane (width blocks) and the sequential
+    oracle (width-1 blocks) so the stat cannot drift between engines."""
+    import jax
+    import jax.numpy as jnp
+
+    sq = sum(
+        ((a.astype(jnp.float32) - b.astype(jnp.float32))
+         .reshape(a.shape[0], -1) ** 2).sum(-1)
+        for a, b in zip(jax.tree.leaves(pre_block),
+                        jax.tree.leaves(upload_block))
+    )
+    return jnp.sqrt(sq)
+
+
+def _masked_median(x, part, m, k):
+    """Median of ``x`` over ``part > 0`` rows with static shapes: the
+    same sort-with-+inf trick as ``robust_reduce`` — non-participants
+    land past every participant, and the order statistics index only
+    the first ``m`` rows."""
+    import jax.numpy as jnp
+
+    s = jnp.sort(jnp.where(part > 0, x, jnp.inf))
+    lo = jnp.clip((m - 1) // 2, 0, k - 1)
+    hi = jnp.clip(m // 2, 0, k - 1)
+    med = 0.5 * (jnp.take(s, lo) + jnp.take(s, hi))
+    return jnp.where(m > 0, med, 0.0)
+
+
+def _robust_z(x, part, m, k, sign: float):
+    """ONE-SIDED robust z-score of each row against the participating
+    cohort's median/MAD (1.4826·MAD ≈ σ under normality): the signed
+    deviation ``sign·(x − med)``, floored at 0. One-sided because only
+    one direction is attack evidence — an above-median upload norm
+    (boosting/sign_flip/noise replacement) or a below-median alignment
+    (anti-aligned upload); the opposite tails are benign structure
+    (small-shard clients ship small deltas, and under krum the selected
+    winner's cosine is exactly 1 — neither may flag). The denominator
+    carries a relative floor so a near-degenerate cohort (MAD ~ 0, all
+    uploads identical) does not turn float noise into flags."""
+    import jax.numpy as jnp
+
+    med = _masked_median(x, part, m, k)
+    mad = _masked_median(jnp.abs(x - med), part, m, k)
+    dev = jnp.maximum(jnp.float32(sign) * (x - med), 0.0)
+    return dev / (
+        jnp.float32(1.4826) * mad + jnp.float32(1e-6) * jnp.abs(med)
+        + jnp.float32(1e-12)
+    )
+
+
+def client_round_stats(uploads, mean_delta, losses, resid, n_ex,
+                       zmax: float):
+    """One round's ``[K, NSTATS]`` per-client stats block (STAT_COLS
+    order), computed from the cohort's WIRE uploads (post clip /
+    compression / attack transform — what the server actually
+    receives) and the round's aggregated delta:
+
+    - ``l2``   — whole-tree L2 norm of the client's upload.
+    - ``cos``  — cosine similarity to the aggregated delta (a sign_flip
+      client sits near −1 while the honest cohort clusters positive).
+    - ``resid``— the :func:`upload_residual` magnitude (clip/EF).
+    - ``loss`` — the client's post-local-train loss.
+    - ``z``    — max of the ONE-SIDED robust z-scores (median/MAD over
+      the participating cohort) of ``l2`` (above-median only) and
+      ``cos`` (below-median only) — the two directions that are attack
+      evidence; see :func:`_robust_z` for why the opposite tails are
+      excluded.
+    - ``flag`` — 1.0 iff ``z > zmax`` and the client participated.
+
+    All math in f32 with one shared implementation across engines; the
+    non-participant rows carry whatever the padded computation produced
+    (their ``flag`` is forced 0) — :func:`update_ledger` drops them."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(uploads)
+    k = leaves[0].shape[0]
+    part = (n_ex > 0).astype(jnp.float32)
+    m = part.sum().astype(jnp.int32)
+    sq = sum(
+        (d.astype(jnp.float32).reshape(k, -1) ** 2).sum(-1) for d in leaves
+    )
+    l2 = jnp.sqrt(sq)
+    mleaves = jax.tree.leaves(mean_delta)
+    dot = sum(
+        (d.astype(jnp.float32).reshape(k, -1)
+         @ g.astype(jnp.float32).reshape(-1))
+        for d, g in zip(leaves, mleaves)
+    )
+    gnorm = jnp.sqrt(sum(
+        (g.astype(jnp.float32) ** 2).sum() for g in mleaves
+    ))
+    cos = dot / (l2 * gnorm + jnp.float32(1e-12))
+    z = jnp.maximum(
+        _robust_z(l2, part, m, k, sign=1.0),   # oversized uploads
+        _robust_z(cos, part, m, k, sign=-1.0),  # anti-aligned uploads
+    )
+    flag = ((z > jnp.float32(zmax)) & (part > 0)).astype(jnp.float32)
+    return jnp.stack(
+        [l2, cos, resid.astype(jnp.float32), losses.astype(jnp.float32),
+         z, flag],
+        axis=1,
+    )
+
+
+def update_ledger(ledger, cohort_ids, n_ex, stats, ema: float):
+    """Scatter one round's stats block into the ``[rows, LEDGER_WIDTH]``
+    ledger: participants' rows get ``count += 1``, ``flagged += flag``,
+    and each EMA column moves by ``ema·(x − ema_x)`` (a client's FIRST
+    observation seeds the EMA with the value itself). Non-participants
+    and poisson pad slots (id == rows) are routed out of bounds, so
+    ``take``'s fill and the ``drop``-mode scatter make them exact
+    no-ops — the same OOB discipline as the EF store scatter. Cohorts
+    sample without replacement, so in-range rows are unique and the
+    scatter is well-defined."""
+    import jax.numpy as jnp
+
+    rows = ledger.shape[0]
+    part = n_ex > 0
+    ids = jnp.where(part, cohort_ids.astype(jnp.int32), jnp.int32(rows))
+    prev = jnp.take(ledger, ids, axis=0, mode="fill", fill_value=0.0)
+    count = prev[:, 0]
+    first = (count <= 0)[:, None]
+    vals = stats[:, :5]  # l2, cos, resid, loss, z
+    emas = prev[:, 2:]
+    new_emas = jnp.where(
+        first, vals, emas + jnp.float32(ema) * (vals - emas)
+    )
+    new_rows = jnp.concatenate(
+        [(count + 1.0)[:, None], (prev[:, 1] + stats[:, 5])[:, None],
+         new_emas],
+        axis=1,
+    )
+    return ledger.at[ids].set(new_rows, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting (`colearn clients`) — pure stdlib + the JSONL
+# ---------------------------------------------------------------------------
+
+
+def latest_ledger_record(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    recs = [r for r in records if r.get("event") == "client_ledger"]
+    if not recs:
+        raise ValueError(
+            "no client_ledger records in this run — enable the ledger "
+            "with run.obs.client_ledger.enabled=true"
+        )
+    return recs[-1]
+
+
+def clients_report(records: List[Dict[str, Any]], top_k: int = 10,
+                   min_flag_rate: float = 0.5) -> Dict[str, Any]:
+    """Fold a run's JSONL into the per-client forensic report: top-k
+    anomalous clients (by cumulative flagged rounds, then EMA z),
+    participation histogram, and — when the run carried an ``attack``
+    provenance event — detection precision/recall of the anomaly flag
+    against the ground-truth compromised set. A client is *detected*
+    when it was flagged in at least ``min_flag_rate`` of its
+    participations (a one-off flag on an honest client should not count
+    as a detection; a persistent attacker is flagged every round)."""
+    led = latest_ledger_record(records)
+    ids = [int(i) for i in led.get("ids", [])]
+    count = [float(c) for c in led.get("count", [])]
+    flagged = [float(f) for f in led.get("flagged", [])]
+    n = len(ids)
+    rate = [flagged[i] / count[i] if count[i] else 0.0 for i in range(n)]
+    clients = []
+    for i in range(n):
+        clients.append({
+            "client": ids[i],
+            "count": int(count[i]),
+            "flagged": int(flagged[i]),
+            "flag_rate": round(rate[i], 4),
+            **{
+                col: round(float(led[col][i]), 6)
+                for col in LEDGER_COLS[2:] if col in led
+            },
+        })
+    by_anomaly = sorted(
+        clients, key=lambda c: (-c["flagged"], -c.get("ema_z", 0.0),
+                                c["client"])
+    )
+    hist: Dict[int, int] = {}
+    for c in count:
+        hist[int(c)] = hist.get(int(c), 0) + 1
+    report: Dict[str, Any] = {
+        "round": int(led.get("round", 0)),
+        "tracked_clients": n,
+        "total_participations": int(sum(count)),
+        "participation_histogram": [
+            [k, v] for k, v in sorted(hist.items())
+        ],
+        "top_anomalous": by_anomaly[:max(0, int(top_k))],
+        "min_flag_rate": min_flag_rate,
+    }
+    attack_ev = next(
+        (r for r in records if r.get("event") == "attack"), None
+    )
+    if attack_ev is not None:
+        byz = {int(c) for c in attack_ev.get("compromised", [])}
+        detected = {
+            c["client"] for c in clients
+            if c["count"] and c["flag_rate"] >= min_flag_rate
+        }
+        seen_byz = byz & set(ids)
+        tp = len(detected & byz)
+        fp = len(detected - byz)
+        fn = len(seen_byz - detected)
+        report["attack"] = {
+            "kind": attack_ev.get("kind"),
+            "n_compromised": len(byz),
+            "n_compromised_seen": len(seen_byz),
+            "detected": sorted(detected),
+            "true_positives": tp,
+            "false_positives": fp,
+            "false_negatives": fn,
+            "precision": round(tp / len(detected), 4) if detected else 0.0,
+            # recall over the compromised clients the ledger could have
+            # seen (ones never sampled into a cohort are undetectable)
+            "recall": round(tp / len(seen_byz), 4) if seen_byz else 0.0,
+        }
+    return report
+
+
+def format_clients_report(report: Dict[str, Any], path: str = "") -> str:
+    """Render the clients report as an aligned text table."""
+    lines = []
+    head = f"run: {path}" if path else "client ledger"
+    head += (
+        f"  round: {report['round']}"
+        f"  clients tracked: {report['tracked_clients']}"
+        f"  participations: {report['total_participations']}"
+    )
+    lines.append(head)
+    hist = report.get("participation_histogram") or []
+    if hist:
+        lines.append(
+            "participation (rounds -> clients): "
+            + ", ".join(f"{k}x{v}" for k, v in hist)
+        )
+    top = report.get("top_anomalous") or []
+    if top:
+        lines.append("")
+        lines.append(
+            f"{'client':>8}{'rounds':>8}{'flagged':>9}{'rate':>7}"
+            f"{'ema_z':>10}{'ema_l2':>11}{'ema_cos':>9}{'ema_loss':>10}"
+        )
+        for c in top:
+            lines.append(
+                f"{c['client']:>8}{c['count']:>8}{c['flagged']:>9}"
+                f"{c['flag_rate']:>7.2f}{c.get('ema_z', 0.0):>10.2f}"
+                f"{c.get('ema_l2', 0.0):>11.4g}"
+                f"{c.get('ema_cos', 0.0):>9.3f}"
+                f"{c.get('ema_loss', 0.0):>10.4g}"
+            )
+    else:
+        lines.append("no clients tracked yet")
+    atk = report.get("attack")
+    if atk:
+        lines.append("")
+        lines.append(
+            f"attack: {atk['kind']}  compromised: {atk['n_compromised']} "
+            f"({atk['n_compromised_seen']} seen)  detected: "
+            f"{len(atk['detected'])}"
+        )
+        lines.append(
+            f"detection precision: {atk['precision']:.3f}  recall: "
+            f"{atk['recall']:.3f}  (flag rate >= "
+            f"{report['min_flag_rate']})"
+        )
+    return "\n".join(lines)
+
+
+def clients_report_path(path: str, top_k: int = 10,
+                        min_flag_rate: float = 0.5) -> Dict[str, Any]:
+    from colearn_federated_learning_tpu.obs.summary import load_records
+
+    return clients_report(load_records(path), top_k=top_k,
+                          min_flag_rate=min_flag_rate)

@@ -23,11 +23,17 @@ the same window it stalled (and as a timeline block in the trace).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 import weakref
 from typing import Any, Dict, List, Optional
+
+# exported-trace size past which export() warns once: multi-GB
+# trace.json files load poorly (or not at all) in Perfetto and are
+# almost always an unintended artifact of a very long traced run
+TRACE_SIZE_WARN_BYTES = 256 * 2**20
 
 
 class _NullSpan:
@@ -97,13 +103,21 @@ class Tracer:
     track stack in Perfetto's flame view.
     """
 
-    def __init__(self, enabled: bool = True, trace: bool = False, clock=None):
+    def __init__(self, enabled: bool = True, trace: bool = False, clock=None,
+                 max_events: int = 0):
         self.enabled = enabled
         self.trace = trace and enabled
         self._clock = clock or time.perf_counter
         self._lock = threading.Lock()
         self._agg: Dict[str, List[float]] = {}  # name -> [count, total_s, max_s]
         self._events: List[Dict[str, Any]] = []
+        # cap on accumulated Chrome-trace events (run.obs.
+        # trace_max_events): long runs otherwise grow trace.json without
+        # bound. 0 = unlimited; past the cap events are DROPPED with one
+        # warning — the per-phase aggregates keep counting everything.
+        self._max_events = int(max_events)
+        self._truncated = False
+        self._size_warned = False
         self._t0 = self._clock()
         self._compiles = 0
         self._compile_secs = 0.0
@@ -147,7 +161,7 @@ class Tracer:
                 }
                 if args:
                     event["args"] = args
-                self._events.append(event)
+                self._append_event(event)
 
     def _note_compile(self, duration: float) -> None:
         with self._lock:
@@ -157,7 +171,7 @@ class Tracer:
                 self._compile_max = duration
             if self.trace:
                 now = self._clock()
-                self._events.append({
+                self._append_event({
                     "name": "compile",
                     "ph": "X",
                     "pid": os.getpid(),
@@ -167,6 +181,22 @@ class Tracer:
                     "ts": max(0.0, (now - self._t0 - duration)) * 1e6,
                     "dur": duration * 1e6,
                 })
+
+    def _append_event(self, event: Dict[str, Any]) -> None:
+        """Append one Chrome-trace event under the event cap (caller
+        holds the lock). Warn ONCE when the cap truncates the trace."""
+        if self._max_events and len(self._events) >= self._max_events:
+            if not self._truncated:
+                self._truncated = True
+                logging.getLogger(__name__).warning(
+                    "trace event cap reached (%d events): further trace "
+                    "events are dropped — raise run.obs.trace_max_events "
+                    "(or set 0 for unbounded) if you need the full "
+                    "timeline; span aggregates are unaffected",
+                    self._max_events,
+                )
+            return
+        self._events.append(event)
 
     # ------------------------------------------------------------------
 
@@ -226,4 +256,18 @@ class Tracer:
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size > TRACE_SIZE_WARN_BYTES and not self._size_warned:
+            # warn once: multi-GB traces from long runs are almost
+            # never intentional and stall (or crash) the trace viewer
+            self._size_warned = True
+            logging.getLogger(__name__).warning(
+                "exported trace %s is %.1f MiB (> %.0f MiB): long runs "
+                "produce very large traces — lower run.obs."
+                "trace_max_events or trace a shorter run",
+                path, size / 2**20, TRACE_SIZE_WARN_BYTES / 2**20,
+            )
         return path

@@ -128,7 +128,8 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=False, feddyn=False, client_dp=0.0,
                          downlink="", secagg_quant_step=0.0,
-                         error_feedback=False, attack=""):
+                         error_feedback=False, attack="",
+                         client_ledger=False):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -259,6 +260,25 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
             raise ValueError(
                 "attack simulation is incompatible with error_feedback "
                 "(a Byzantine residual memory is unbounded hidden state)"
+            )
+    if client_ledger:
+        # mirror config.validate()'s client_ledger pairing rejections
+        # so a direct engine caller can't build a forensic ledger over
+        # uploads the protocol hides (or a DP release it would void)
+        if secagg:
+            raise ValueError(
+                "client_ledger is incompatible with secure aggregation "
+                "(per-client upload statistics are what masking hides)"
+            )
+        if client_dp > 0.0:
+            raise ValueError(
+                "client_ledger is incompatible with client-level DP "
+                "(a per-client statistics channel voids the release)"
+            )
+        if scaffold or feddyn:
+            raise ValueError(
+                "client_ledger is not supported with stateful "
+                "algorithms (they own the per-client state path)"
             )
 
 
@@ -529,7 +549,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           attack: str = "",
                           attack_scale: float = 10.0,
                           attack_eps: float = 1.0,
-                          on_device_mask: bool = False):
+                          on_device_mask: bool = False,
+                          client_ledger: bool = False,
+                          ledger_ema: float = 0.2,
+                          ledger_zmax: float = 3.5):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -648,12 +671,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     ``robust_reduce`` under a robust ``aggregator``. The transform and
     the stack aggregation are one shared implementation with the
     sequential oracle, so attacked-round parity holds by construction.
+
+    ``client_ledger`` (obs/ledger.py): the round fn takes two extra
+    trailing inputs — the ``[num_clients, LEDGER_WIDTH]`` float32
+    ledger store (replicated) and the ``[K]`` int32 cohort ids — and
+    returns the updated ledger just before the metrics. The per-client
+    stats block (upload L2, cosine vs the aggregated delta, clip/EF
+    residual, loss, robust-z flag) is computed in-program from the
+    cohort's WIRE uploads (post clip/compression/attack) and scattered
+    into the ledger with the EF store's OOB-drop discipline; the
+    params trajectory is untouched — the weighted-mean path still
+    aggregates through its psum, the upload stack only feeds the
+    stats. Under ``fuse_rounds > 1`` the ledger rides the scan carry
+    and the cohort ids a stacked ``[fuse, K]`` input.
     """
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
                          secagg_quant_step=secagg_quant_step,
-                         error_feedback=error_feedback, attack=attack)
+                         error_feedback=error_feedback, attack=attack,
+                         client_ledger=client_ledger)
     if client_dp_noise > 0.0 and agg != "uniform":
         # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
         raise ValueError(
@@ -878,6 +915,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 lambda w, p: w.astype(jnp.float32) - p[None].astype(jnp.float32),
                 w_b, params,
             )
+            # client_ledger: the residual stat compares what the client
+            # computed against what it ships — raw delta on the plain
+            # path, the EF accumulator (delta + memory) under EF
+            pre_b = delta_b if client_ledger else None
             if clip_delta_norm > 0.0:
                 delta_b = _clip_block(delta_b, clip_delta_norm)
             if error_feedback:
@@ -894,6 +935,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 acc_b = jax.tree.map(
                     lambda d, e: d + e.astype(jnp.float32), delta_b, b_c
                 )
+                if client_ledger:
+                    pre_b = acc_b  # ledger resid = ||e_i^+|| under EF
                 comp_b = compress(acc_b, b_keys)
                 ys["c"] = jax.tree.map(
                     lambda a, cp, e: jnp.where(
@@ -904,11 +947,21 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 delta_b = comp_b
             elif compress is not None:
                 delta_b = compress(delta_b, b_keys)
-            if emit_stack:
+            if emit_stack or client_ledger:
                 # robust/attacked modes need every client's delta
                 # individually — emit the block's deltas instead of
-                # accumulating
+                # accumulating; the ledger emits them ALONGSIDE the
+                # psum accumulation (stats only — aggregation unchanged)
                 ys["delta"] = delta_b
+            if client_ledger:
+                from colearn_federated_learning_tpu.obs.ledger import (
+                    upload_residual,
+                )
+
+                ys["pc_loss"] = m_b.loss
+                ys["pc_resid"] = upload_residual(pre_b, delta_b)
+            if emit_stack:
+                pass  # the stack IS the aggregation input downstream
             elif secagg:
                 # survivor uploads + server mask reconstruction for
                 # dropped clients (n = 0); the int32 accumulator's
@@ -1016,9 +1069,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             jnp.float32(dp_fixed_denom or cohort_size)
             if client_dp_noise > 0.0 else denom
         )
-        if emit_stack:
+        if emit_stack or client_ledger:
             out["deltas"] = unblock(ys["delta"])  # client-sharded stack
-        else:
+        if client_ledger:
+            # per-client loss / residual-magnitude columns of the
+            # ledger stats block ([K], client-sharded like the stack)
+            out["pc_loss"] = unblock(ys["pc_loss"])
+            out["pc_resid"] = unblock(ys["pc_resid"])
+        if not emit_stack:
             d_sum = jax.lax.psum(d_sum, CLIENT_AXIS)
             if secagg:
                 # the cross-lane psum completed the mod-2^32 ring — masks
@@ -1088,9 +1146,12 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     if client_dp_noise > 0.0:
         in_specs += (P(),)  # central DP noise key, replicated
     out_specs = {"n": P(), "loss": P()}
-    if emit_stack:
+    if emit_stack or client_ledger:
         out_specs["deltas"] = P(CLIENT_AXIS)
-    else:
+    if client_ledger:
+        out_specs["pc_loss"] = P(CLIENT_AXIS)
+        out_specs["pc_resid"] = P(CLIENT_AXIS)
+    if not emit_stack:
         out_specs["mean_delta"] = P()
     if stateful:
         out_specs["dc_sum"] = P()
@@ -1103,22 +1164,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         out_specs=out_specs,
     )
 
-    def _mean_delta(out, n_ex, params=None, byz=None, keys=None):
-        if emit_stack:
-            deltas = out["deltas"]
-            if attack:
-                from colearn_federated_learning_tpu.server.attacks import (
-                    apply_upload_attack,
-                )
+    def _wire_stack(out, n_ex, byz, keys):
+        """The cohort's [K, ...] WIRE uploads: the lane-emitted stack
+        with the attack transform applied (plain jnp under the same
+        jit — GSPMD handles the client-sharded axis), after clipping/
+        compression and before aggregation: the upload boundary. Feeds
+        the robust/attacked aggregation AND the client-ledger stats."""
+        deltas = out["deltas"]
+        if attack:
+            from colearn_federated_learning_tpu.server.attacks import (
+                apply_upload_attack,
+            )
 
-                # the attack transform acts on the global [K, ...]
-                # stack under the same jit (plain jnp — GSPMD handles
-                # the client-sharded axis), after clipping/compression
-                # and before aggregation: the upload boundary
-                deltas = apply_upload_attack(
-                    deltas, byz, keys, attack, attack_scale, attack_eps,
-                    participation=n_ex > 0,
-                )
+            deltas = apply_upload_attack(
+                deltas, byz, keys, attack, attack_scale, attack_eps,
+                participation=n_ex > 0,
+            )
+        return deltas
+
+    def _mean_delta(out, n_ex, params=None, wire=None):
+        if emit_stack:
             if robust:
                 from colearn_federated_learning_tpu.server.aggregation import (
                     robust_reduce,
@@ -1126,7 +1191,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 
                 # the coordinate-wise sort runs as plain jnp under jit —
                 # GSPMD handles the lanes
-                return robust_reduce(deltas, n_ex > 0, aggregator,
+                return robust_reduce(wire, n_ex > 0, aggregator,
                                      trim_ratio, byzantine_f)
             from colearn_federated_learning_tpu.server.attacks import (
                 stack_weighted_mean,
@@ -1135,8 +1200,26 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             # weighted_mean over the (attacked) stack — the stacked-path
             # twin of the in-lane psum accumulation, shared with the
             # sequential oracle
-            return stack_weighted_mean(deltas, n_ex, agg, params)
+            return stack_weighted_mean(wire, n_ex, agg, params)
         return out["mean_delta"]
+
+    def _ledger_update(out, wire, mean_delta, n_ex, ledger, cohort):
+        """In-program ledger step: the shared stats block over the wire
+        uploads, scattered into the device-resident store (obs/ledger).
+        Runs under the round jit — zero extra host round-trips."""
+        from colearn_federated_learning_tpu.obs.ledger import (
+            client_round_stats,
+            update_ledger,
+        )
+
+        with jax.named_scope("round_client_ledger"):
+            stats = client_round_stats(
+                wire, mean_delta, out["pc_loss"], out["pc_resid"], n_ex,
+                ledger_zmax,
+            )
+            return update_ledger(
+                ledger, cohort.astype(jnp.int32), n_ex, stats, ledger_ema
+            )
 
     if stateful:
 
@@ -1203,7 +1286,9 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 break
 
         def _ef_one_round(params, server_opt_state, train_x, train_y, idx,
-                          mask, n_ex, rng, e_clients, cohort):
+                          mask, n_ex, rng, e_clients, cohort, ledger=None):
+            if client_ledger and ledger is None:
+                raise TypeError("client_ledger requires the ledger input")
             keys = _cohort_keys(rng, idx.shape[0])
             extra = ()
             if use_decay:
@@ -1213,46 +1298,71 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     _bcast(params, rng), train_x, train_y, idx, mask, n_ex,
                     keys, *extra, e_clients, cohort.astype(jnp.int32),
                 )
+            new_ledger = None
+            if client_ledger:
+                # EF aggregates through the psum path; the stats block
+                # reads the emitted C(delta+e) upload stack
+                new_ledger = _ledger_update(
+                    out, out["deltas"], out["mean_delta"], n_ex, ledger,
+                    cohort,
+                )
             with jax.named_scope("round_server_apply"):
                 new_params, new_opt_state = server_update(
                     params, server_opt_state, out["mean_delta"]
                 )
-            return (new_params, new_opt_state, out["c_all"],
-                    RoundMetrics(out["loss"], out["n"]))
+            metrics = RoundMetrics(out["loss"], out["n"])
+            if client_ledger:
+                return (new_params, new_opt_state, out["c_all"],
+                        new_ledger, metrics)
+            return new_params, new_opt_state, out["c_all"], metrics
 
         if fuse_rounds > 1:
             # fused EF: the device-resident [N_pad, ...] residual store
             # is a DONATED scan carry — the in-program scatter updates
             # it each fused sub-round with zero host involvement, and
-            # the store buffer is reused across the whole chunk
+            # the store buffer is reused across the whole chunk. The
+            # client ledger (when on) rides the same carry.
+            _ef_donate = (0, 1, 8) + ((10,) if client_ledger else ())
 
-            @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
+            @partial(jax.jit, donate_argnums=_ef_donate if donate else ())
             def round_fn(params, server_opt_state, train_x, train_y, idx_f,
-                         mask_f, n_ex_f, rngs, e_clients, cohorts):
+                         mask_f, n_ex_f, rngs, e_clients, cohorts,
+                         ledger=None):
                 _ef_check(e_clients)
+                if client_ledger and ledger is None:
+                    raise TypeError("client_ledger requires the ledger input")
 
                 def body(carry, inp):
-                    p, o, e = carry
+                    p, o, e, led = carry
                     i, m, n, r, coh = inp
-                    p, o, e, met = _ef_one_round(
-                        p, o, train_x, train_y, i, m, n, r, e, coh
+                    res = _ef_one_round(
+                        p, o, train_x, train_y, i, m, n, r, e, coh, led
                     )
-                    return (p, o, e), met
+                    if client_ledger:
+                        p, o, e, led, met = res
+                    else:
+                        p, o, e, met = res
+                    return (p, o, e, led), met
 
-                (p, o, e), ms = jax.lax.scan(
-                    body, (params, server_opt_state, e_clients),
+                (p, o, e, led), ms = jax.lax.scan(
+                    body, (params, server_opt_state, e_clients, ledger),
                     (idx_f, mask_f, n_ex_f, rngs, cohorts),
                 )
+                if client_ledger:
+                    return p, o, e, led, ms
                 return p, o, e, ms  # RoundMetrics with [F]-stacked fields
 
             return round_fn
 
-        @partial(jax.jit, donate_argnums=(0, 1, 8) if donate else ())
+        _ef_donate1 = (0, 1, 8) + ((10,) if client_ledger else ())
+
+        @partial(jax.jit, donate_argnums=_ef_donate1 if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx, mask,
-                     n_ex, rng, e_clients, cohort):
+                     n_ex, rng, e_clients, cohort, ledger=None):
             _ef_check(e_clients)
             return _ef_one_round(params, server_opt_state, train_x, train_y,
-                                 idx, mask, n_ex, rng, e_clients, cohort)
+                                 idx, mask, n_ex, rng, e_clients, cohort,
+                                 ledger)
 
         return round_fn
 
@@ -1295,9 +1405,13 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         return round_fn
 
     def _one_round(params, server_opt_state, train_x, train_y, idx, mask,
-                   n_ex, rng, byz=None):
+                   n_ex, rng, byz=None, ledger=None, cohort=None):
         if attack and byz is None:
             raise TypeError(f"attack={attack!r} requires the byz mask input")
+        if client_ledger and (ledger is None or cohort is None):
+            raise TypeError(
+                "client_ledger requires the ledger and cohort inputs"
+            )
         keys = _cohort_keys(rng, idx.shape[0])
         extra = ()
         if use_decay:
@@ -1316,13 +1430,23 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 _bcast(params, rng), train_x, train_y, idx, mask, n_ex, keys,
                 *extra, *tail,
             )
+        wire = None
+        if emit_stack or client_ledger:
+            wire = _wire_stack(out, n_ex, byz, keys)
         with jax.named_scope("round_aggregate"):
-            delta = _mean_delta(out, n_ex, params, byz, keys)
+            delta = _mean_delta(out, n_ex, params, wire)
+        new_ledger = None
+        if client_ledger:
+            new_ledger = _ledger_update(out, wire, delta, n_ex, ledger,
+                                        cohort)
         with jax.named_scope("round_server_apply"):
             new_params, new_opt_state = server_update(
                 params, server_opt_state, delta
             )
-        return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
+        metrics = RoundMetrics(out["loss"], out["n"])
+        if client_ledger:
+            return new_params, new_opt_state, new_ledger, metrics
+        return new_params, new_opt_state, metrics
 
     if fuse_rounds > 1:
         # Multi-round fusion (r5, VERDICT r4 weak-#2; generalized r6):
@@ -1338,29 +1462,51 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         # program — and the per-round byzantine masks ride a stacked
         # [F, K] scan input alongside n_ex_f.
 
-        @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        _fuse_donate = (0, 1) + ((9,) if client_ledger else ())
+
+        @partial(jax.jit, donate_argnums=_fuse_donate if donate else ())
         def round_fn(params, server_opt_state, train_x, train_y, idx_f,
-                     mask_f, n_ex_f, rngs, byz_f=None):
+                     mask_f, n_ex_f, rngs, byz_f=None, ledger=None,
+                     cohorts_f=None):
             if attack and byz_f is None:
                 raise TypeError(
                     f"attack={attack!r} requires the stacked [fuse, K] "
                     f"byz mask input"
                 )
+            if client_ledger and (ledger is None or cohorts_f is None):
+                raise TypeError(
+                    "client_ledger requires the ledger input and the "
+                    "stacked [fuse, K] cohort ids"
+                )
 
             def body(carry, inp):
-                p, o = carry
-                if attack:
-                    i, m, n, r, bz = inp
+                p, o, led = carry
+                rest = list(inp)
+                i, m, n, r = rest[:4]
+                rest = rest[4:]
+                bz = rest.pop(0) if attack else None
+                coh = rest.pop(0) if client_ledger else None
+                res = _one_round(p, o, train_x, train_y, i, m, n, r,
+                                 bz, led, coh)
+                if client_ledger:
+                    p, o, led, met = res
                 else:
-                    (i, m, n, r), bz = inp, None
-                p, o, met = _one_round(p, o, train_x, train_y, i, m, n, r,
-                                       bz)
-                return (p, o), met
+                    p, o, met = res
+                return (p, o, led), met
 
             xs = (idx_f, mask_f, n_ex_f, rngs)
             if attack:
                 xs += (byz_f,)
-            (p, o), ms = jax.lax.scan(body, (params, server_opt_state), xs)
+            if client_ledger:
+                # the ledger rides the scan CARRY (donated — the store
+                # buffer is reused across the chunk, like the EF store);
+                # per-sub-round cohort ids ride a stacked scan input
+                xs += (cohorts_f,)
+            (p, o, led), ms = jax.lax.scan(
+                body, (params, server_opt_state, ledger), xs
+            )
+            if client_ledger:
+                return p, o, led, ms
             return p, o, ms  # RoundMetrics with [F]-stacked fields
 
         return round_fn
@@ -1368,7 +1514,10 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     # keep the compiled program's name "jit_round_fn": profiling tools
     # (bench._parse_device_ms) identify the round program by it
     _one_round.__name__ = "round_fn"
-    round_fn = partial(jax.jit, donate_argnums=(0, 1) if donate else ())(
+    # the ledger input (arg 9, passed positionally by the driver) is
+    # donated like the state stores — the round updates it in place
+    _donate = (0, 1) + ((9,) if client_ledger else ())
+    round_fn = partial(jax.jit, donate_argnums=_donate if donate else ())(
         _one_round
     )
     return round_fn
@@ -1548,7 +1697,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              attack: str = "",
                              attack_scale: float = 10.0,
                              attack_eps: float = 1.0,
-                             on_device_mask: bool = False):
+                             on_device_mask: bool = False,
+                             client_ledger: bool = False,
+                             ledger_ema: float = 0.2,
+                             ledger_zmax: float = 3.5):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -1560,14 +1712,19 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     ``on_device_mask`` mirrors the sharded engine's compact-spec mask
     input: ``mask`` arrives as the ``[K, 2]`` spec and is expanded to
     the identical full float32 mask before the loop (the loop itself is
-    the oracle — it sees exactly what the lanes rebuild in-program)."""
+    the oracle — it sees exactly what the lanes rebuild in-program).
+    ``client_ledger`` mirrors the sharded engine: the round fn takes
+    ``ledger`` + ``ledger_ids`` and returns the updated ledger before
+    the metrics, built from the SAME shared stats/update helpers
+    (obs/ledger.py) over the same wire-upload stack."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
                          client_dp=client_dp_noise, downlink=downlink,
                          secagg_quant_step=secagg_quant_step,
-                         error_feedback=error_feedback, attack=attack)
+                         error_feedback=error_feedback, attack=attack,
+                         client_ledger=client_ledger)
     if client_dp_noise > 0.0 and agg != "uniform":
         raise ValueError(
             "client-level DP requires uniform aggregation weights "
@@ -1606,9 +1763,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     )
 
     def round_fn(params, server_opt_state, train_x, train_y, idx, mask, n_ex, rng,
-                 c_global=None, c_cohort=None, pair_seeds=None, byz=None):
+                 c_global=None, c_cohort=None, pair_seeds=None, byz=None,
+                 ledger=None, ledger_ids=None):
         if attack and byz is None:
             raise TypeError(f"attack={attack!r} requires the byz mask input")
+        if client_ledger and (ledger is None or ledger_ids is None):
+            raise TypeError(
+                "client_ledger requires the ledger and ledger_ids inputs"
+            )
         if on_device_mask:
             import numpy as _np
 
@@ -1627,7 +1789,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             if use_decay else None
         )
         extra = (lr_scale,) if use_decay else ()
-        deltas, weights, losses = [], [], []
+        deltas, weights, losses, resids = [], [], [], []
         # the weights clients receive this round (identical dither
         # derivation as the sharded engine — parity holds)
         bcast = params
@@ -1702,6 +1864,10 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 lambda w, p: w.astype(jnp.float32) - p.astype(jnp.float32),
                 w_i, bcast,
             )
+            # client_ledger resid: raw-vs-shipped on the plain path,
+            # the EF accumulator residual below (same rule as the lane)
+            pre_i = delta_i if client_ledger else None
+            resid_c = None
             if clip_delta_norm > 0.0 or compress is not None:
                 # one width-1 block through the SAME operators as the
                 # sharded lane (clip first, then EF memory, then
@@ -1715,6 +1881,12 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                     )
                     acc_block = jax.tree.map(jnp.add, block, e_block)
                     comp_block = compress(acc_block, keys[c][None])
+                    if client_ledger:
+                        from colearn_federated_learning_tpu.obs.ledger import (
+                            upload_residual,
+                        )
+
+                        resid_c = upload_residual(acc_block, comp_block)[0]
                     part_c = (jnp.asarray(n_ex[c]) > 0)
                     new_cs.append(jax.tree.map(
                         lambda a, cp, e: jnp.where(part_c, a - cp, e)[0],
@@ -1724,6 +1896,17 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 elif compress is not None:
                     block = compress(block, keys[c][None])
                 delta_i = jax.tree.map(lambda a: a[0], block)
+            if client_ledger:
+                if resid_c is None:
+                    from colearn_federated_learning_tpu.obs.ledger import (
+                        upload_residual,
+                    )
+
+                    resid_c = upload_residual(
+                        jax.tree.map(lambda a: a[None], pre_i),
+                        jax.tree.map(lambda a: a[None], delta_i),
+                    )[0]
+                resids.append(resid_c)
             n_c = jnp.asarray(n_ex[c])
             weights.append(n_c if agg == "examples" else (n_c > 0).astype(n_c.dtype))
             losses.append(m_i.loss)
@@ -1817,6 +2000,28 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             mean_delta = _client_dp_noise(
                 jax.random.fold_in(rng, _CLIENT_DP_FOLD), mean_delta, std
             )
+        new_ledger = None
+        if client_ledger:
+            # the SAME shared stats + scatter helpers as the sharded
+            # program, applied to the same wire stack — ledger parity
+            # across engines holds by construction
+            from colearn_federated_learning_tpu.obs.ledger import (
+                client_round_stats,
+                update_ledger,
+            )
+
+            wire = (
+                stacked if (robust or attack)
+                else jax.tree.map(lambda *ls: jnp.stack(ls), *deltas)
+            )
+            stats = client_round_stats(
+                wire, mean_delta, jnp.stack(losses), jnp.stack(resids),
+                jnp.asarray(n_ex), ledger_zmax,
+            )
+            new_ledger = update_ledger(
+                jnp.asarray(ledger), jnp.asarray(ledger_ids),
+                jnp.asarray(n_ex), stats, ledger_ema,
+            )
         mean_loss = sum(w * l for w, l in zip(weights, losses)) / denom
         if stateful:
             new_c_global = jax.tree.map(
@@ -1841,7 +2046,13 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
         new_params, new_opt_state = update(params, server_opt_state, mean_delta)
         if error_feedback:
             new_e_cohort = jax.tree.map(lambda *ls: jnp.stack(ls), *new_cs)
+            if client_ledger:
+                return (new_params, new_opt_state, new_e_cohort, new_ledger,
+                        RoundMetrics(mean_loss, n_total))
             return (new_params, new_opt_state, new_e_cohort,
+                    RoundMetrics(mean_loss, n_total))
+        if client_ledger:
+            return (new_params, new_opt_state, new_ledger,
                     RoundMetrics(mean_loss, n_total))
         return new_params, new_opt_state, RoundMetrics(mean_loss, n_total)
 

@@ -219,6 +219,20 @@ class Experiment:
         self._attack_upload = self.attack_kind in UPLOAD_ATTACKS
         self.compromised = np.zeros(0, np.int64)
         self._attack_stats: Dict[int, int] = {}
+        # Per-client forensic ledger (run.obs.client_ledger, obs/
+        # ledger.py): each round program emits a [K] per-client stats
+        # block (upload L2 / cosine-vs-aggregate / clip-EF residual /
+        # loss / robust-z flag) and scatters it into a device-resident
+        # [num_clients, LEDGER_WIDTH] store carried across rounds —
+        # periodic `client_ledger` JSONL records + the `colearn
+        # clients` report read it. validate() already rejected the
+        # unsound pairings (secagg, client-DP, gossip/fedbuff,
+        # stateful algorithms).
+        lcfg = cfg.run.obs.client_ledger
+        self._ledger_on = lcfg.enabled
+        self._ledger_cfg = lcfg
+        self._ledger_ref = None
+        self._ledger_logged_round = -1
         if self.attack_kind:
             self.compromised = select_compromised(
                 self.fed.num_clients, cfg.attack.fraction, cfg.run.seed
@@ -344,6 +358,9 @@ class Experiment:
                         attack_scale=cfg.attack.scale,
                         attack_eps=cfg.attack.eps,
                         on_device_mask=self._spec_inputs,
+                        client_ledger=self._ledger_on,
+                        ledger_ema=lcfg.ema,
+                        ledger_zmax=lcfg.zmax,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
@@ -389,6 +406,9 @@ class Experiment:
                 attack_scale=cfg.attack.scale,
                 attack_eps=cfg.attack.eps,
                 on_device_mask=self._spec_inputs,
+                client_ledger=self._ledger_on,
+                ledger_ema=lcfg.ema,
+                ledger_zmax=lcfg.zmax,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -514,7 +534,8 @@ class Experiment:
         # boundaries. Trace export is single-writer like the JSONL.
         obs = cfg.run.obs
         self.tracer = Tracer(
-            enabled=obs.spans, trace=obs.trace and self._primary
+            enabled=obs.spans, trace=obs.trace and self._primary,
+            max_events=obs.trace_max_events,
         )
         self.health = (
             HealthMonitor(obs.divergence_factor) if obs.health else None
@@ -763,6 +784,18 @@ class Experiment:
                 lambda p: np.zeros((self._state_rows,) + p.shape, np.float32),
                 params,
             )
+        if self._ledger_on:
+            # per-client forensic ledger rows (count, flagged, EMAs);
+            # row index == client id, no lane padding (the store is
+            # replicated — it is a few KB). Poisson pad slots (id ==
+            # num_clients) scatter out of bounds and drop.
+            from colearn_federated_learning_tpu.obs.ledger import (
+                LEDGER_WIDTH,
+            )
+
+            state["ledger"] = np.zeros(
+                (self.fed.num_clients, LEDGER_WIDTH), np.float32
+            )
         if self.gossip:
             # every client starts at the same point (the standard
             # consensus init); the stack is host numpy until
@@ -856,6 +889,13 @@ class Experiment:
                     else np.array(a, dtype=np.float32, copy=True),
                     state["c_clients"],
                 )
+        if self._ledger_on:
+            # ledger: replicated device array (tiny); a warm-start or
+            # restored ledger arrives as jax/numpy — both place fine
+            state["ledger"] = self._put(
+                jnp.asarray(np.asarray(state["ledger"], np.float32)),
+                self._data_sharding,
+            )
         if self.gossip:
             # warm-start replicas from a previous fit() on this
             # Experiment are already device-resident + client-sharded;
@@ -1387,6 +1427,7 @@ class Experiment:
             common = (state["params"], state["server_opt_state"],
                       train_x, train_y, idx, mask, n_ex, rng)
             glob = (state["c_global"],) if self.stateful else ()
+            ledger = None
             if self._data_sharding is not None:
                 # device-resident store: the cohort gather/scatter runs
                 # INSIDE the round program (donated, so the store is
@@ -1395,12 +1436,17 @@ class Experiment:
                     jnp.asarray(np.asarray(cohort, np.int32)),
                     self._data_sharding,
                 )
+                ltail = (state["ledger"],) if self._ledger_on else ()
                 with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
                         self.tracer.span("round.dispatch"):
                     out = round_fn(
                         *common, *glob, state["c_clients"], cohort_dev,
+                        *ltail,
                     )
-                *head, c_clients, metrics = out
+                if self._ledger_on:
+                    *head, c_clients, ledger, metrics = out
+                else:
+                    *head, c_clients, metrics = out
             else:
                 # sequential oracle: host-resident numpy store with an
                 # explicit per-round gather/scatter. Poisson pad slots
@@ -1414,12 +1460,23 @@ class Experiment:
                 c_cohort = jax.tree.map(
                     lambda a: jnp.asarray(a[safe]), state["c_clients"]
                 )
+                lkw = {}
+                if self._ledger_on:
+                    lkw = dict(
+                        ledger=state["ledger"],
+                        ledger_ids=jnp.asarray(
+                            np.asarray(cohort, np.int32)
+                        ),
+                    )
                 with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
                         self.tracer.span("round.dispatch"):
                     out = round_fn(
-                        *common, *(glob or (None,)), c_cohort,
+                        *common, *(glob or (None,)), c_cohort, **lkw,
                     )
-                *head, new_c_cohort, metrics = out
+                if self._ledger_on:
+                    *head, new_c_cohort, ledger, metrics = out
+                else:
+                    *head, new_c_cohort, metrics = out
                 fetched = jax.device_get(new_c_cohort)
                 jax.tree.map(
                     lambda store, f: store.__setitem__(
@@ -1436,6 +1493,8 @@ class Experiment:
                 "c_clients": c_clients,
                 "_metrics": metrics,
             }
+            if self._ledger_on:
+                new_state["ledger"] = ledger
             if self.stateful:
                 new_state["c_global"] = head[2]
             return new_state
@@ -1443,6 +1502,36 @@ class Experiment:
         if self.secagg and self.cfg.server.secagg_mode == "pairwise":
             with self.tracer.span("round.secagg_keys"):
                 kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
+        if self._ledger_on:
+            cohort_ids = jnp.asarray(np.asarray(cohort, np.int32))
+            if self._data_sharding is not None:
+                # sharded: positional trailing (byz, ledger, cohort) so
+                # the ledger input stays donatable
+                with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
+                        self.tracer.span("round.dispatch"):
+                    params, opt_state, ledger, metrics = round_fn(
+                        state["params"], state["server_opt_state"],
+                        train_x, train_y, idx, mask, n_ex, rng,
+                        kw.get("byz"), state["ledger"],
+                        self._put(cohort_ids, self._data_sharding),
+                    )
+            else:
+                with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
+                        self.tracer.span("round.dispatch"):
+                    params, opt_state, ledger, metrics = round_fn(
+                        state["params"], state["server_opt_state"],
+                        train_x, train_y, idx, mask, n_ex, rng,
+                        ledger=state["ledger"], ledger_ids=cohort_ids,
+                        **kw,
+                    )
+            return {
+                "params": params,
+                "server_opt_state": opt_state,
+                "round": round_idx + 1,
+                "rng_key": state["rng_key"],
+                "ledger": ledger,
+                "_metrics": metrics,
+            }
         with self._bucket_compile_span(round_idx, int(idx.shape[1])), \
                 self.tracer.span("round.dispatch"):
             params, opt_state, metrics = round_fn(
@@ -1528,17 +1617,32 @@ class Experiment:
                 tail = (self._put(
                     np.stack(byz_rows), self._fused_client_sharding
                 ),)
-            if self.ef:
+            if self.ef or self._ledger_on:
                 cohorts_f = self._put(
                     np.stack(cohorts), self._data_sharding
                 )
         common = (state["params"], state["server_opt_state"], train_x,
                   train_y, idx_f, mask_f, n_ex_f, rngs_f)
+        ledger = None
         with self._bucket_compile_span(round_idx, int(idx_f.shape[2])), \
                 self.tracer.span("round.dispatch", fuse=fuse):
             if self.ef:
-                params, opt_state, c_clients, metrics = self.round_fn(
-                    *common, state["c_clients"], cohorts_f,
+                if self._ledger_on:
+                    (params, opt_state, c_clients, ledger,
+                     metrics) = self.round_fn(
+                        *common, state["c_clients"], cohorts_f,
+                        state["ledger"],
+                    )
+                else:
+                    params, opt_state, c_clients, metrics = self.round_fn(
+                        *common, state["c_clients"], cohorts_f,
+                    )
+            elif self._ledger_on:
+                # the ledger rides the fused scan carry; per-sub-round
+                # cohort ids are a stacked [fuse, K] scan input
+                params, opt_state, ledger, metrics = self.round_fn(
+                    *common, tail[0] if tail else None, state["ledger"],
+                    cohorts_f,
                 )
             else:
                 params, opt_state, metrics = self.round_fn(*common, *tail)
@@ -1549,6 +1653,8 @@ class Experiment:
             "rng_key": state["rng_key"],
             "_metrics": metrics,
         }
+        if self._ledger_on:
+            new_state["ledger"] = ledger
         if self.ef:
             new_state["c_clients"] = c_clients
         return new_state
@@ -1631,8 +1737,48 @@ class Experiment:
                 f"original algorithm/error_feedback settings"
             )
 
+    def _log_ledger(self, round_idx: int) -> None:
+        """Emit one columnar `client_ledger` JSONL record from the
+        device-resident ledger (rows with at least one participation).
+        Called at periodic flush boundaries and — via fit()'s finally —
+        on EVERY exit path, so aborted runs (HealthAbortError,
+        KeyboardInterrupt, crashes) still land their partial ledger,
+        mirroring the trace-on-abort guarantee."""
+        if self._ledger_ref is None:
+            return
+        from colearn_federated_learning_tpu.obs.ledger import LEDGER_COLS
+
+        led = np.asarray(jax.device_get(self._ledger_ref))
+        active = np.flatnonzero(led[:, 0] > 0)
+        rec: Dict[str, Any] = {
+            "event": "client_ledger",
+            "round": int(round_idx),
+            "num_clients": int(led.shape[0]),
+            "ema": self._ledger_cfg.ema,
+            "zmax": self._ledger_cfg.zmax,
+            "ids": [int(i) for i in active],
+            "count": [int(v) for v in led[active, 0]],
+            "flagged": [int(v) for v in led[active, 1]],
+        }
+        for j, col in enumerate(LEDGER_COLS[2:], start=2):
+            rec[col] = [round(float(v), 6) for v in led[active, j]]
+        self.logger.log(rec)
+        self._ledger_logged_round = int(round_idx)
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         caller_state = state is not None
+        # per-fit accumulators for the end-of-fit `run_summary` record
+        # (cumulative wire bytes, rounds, wall time, compile count) and
+        # the ledger's final flush
+        self._fit_t0 = time.perf_counter()
+        self._rounds_done = 0
+        self._run_totals = {
+            k: 0 for k in ("upload_bytes", "upload_bytes_raw",
+                           "download_bytes", "download_bytes_raw")
+        }
+        self._total_compiles = 0
+        self._total_compile_ms = 0.0
+        self._ledger_logged_round = -1
         # Checkpoint provenance baseline: only checkpoints written BY THIS
         # fit() call may be restored on retry — restoring a stale
         # checkpoint left in the same out_dir by an earlier run would
@@ -1690,6 +1836,29 @@ class Experiment:
                     state = restored
         finally:
             self._stop_prefetch()
+            if self._ledger_on and self._ledger_ref is not None:
+                # final (or abort-path partial) ledger flush — same
+                # every-exit-path guarantee as the trace export below
+                try:
+                    if self._ledger_logged_round != self._rounds_done:
+                        self._log_ledger(self._rounds_done)
+                except Exception as e:
+                    print(f"client_ledger flush failed: {e}", flush=True)
+            try:
+                # end-of-fit run_summary: totals that otherwise require
+                # re-aggregating the whole JSONL (aborts included)
+                self.logger.log({
+                    "event": "run_summary",
+                    "rounds": int(self._rounds_done),
+                    "wall_time_sec": round(
+                        time.perf_counter() - self._fit_t0, 3
+                    ),
+                    "compiles": int(self._total_compiles),
+                    "compile_ms": round(self._total_compile_ms, 3),
+                    **{k: int(v) for k, v in self._run_totals.items()},
+                })
+            except Exception as e:
+                print(f"run_summary log failed: {e}", flush=True)
             if self.tracer.trace and self.cfg.run.out_dir:
                 # end-of-fit Chrome-trace dump (aborted/failed runs
                 # included — the trace is the post-mortem artifact)
@@ -1737,7 +1906,10 @@ class Experiment:
             else:
                 state = self.init_state()
         state = self._place_state(state)
+        if self._ledger_on:
+            self._ledger_ref = state.get("ledger")
         start_round = int(state["round"])
+        self._rounds_done = max(self._rounds_done, start_round)
         if start_round == 0 and self._poisson:
             self.logger.log({
                 "event": "poisson_sampling",
@@ -1768,7 +1940,9 @@ class Experiment:
                 "scale": cfg.attack.scale,
                 "eps": cfg.attack.eps,
                 "n_compromised": int(len(self.compromised)),
-                "compromised": [int(c) for c in self.compromised[:64]],
+                # the FULL set (one event per run): the `colearn
+                # clients` report scores the anomaly flag against it
+                "compromised": [int(c) for c in self.compromised],
             })
         if start_round == 0 and cfg.dp.enabled and cfg.dp.clipping == "two_pass":
             # ADVICE r5 #1: two_pass clipping is exact only up to
@@ -1822,6 +1996,11 @@ class Experiment:
             — one `spans` record per flush window, not per span."""
             phases = self.tracer.drain()
             if phases:
+                comp = phases.get("compile")
+                if comp:
+                    # run_summary accounting: lifetime compile totals
+                    self._total_compiles += comp["count"]
+                    self._total_compile_ms += comp["total_ms"]
                 self.logger.log({
                     "event": "spans", "round": last_round, "phases": phases,
                 })
@@ -1901,9 +2080,21 @@ class Experiment:
                     record["client_updates_per_sec_per_chip"] = round(updates_per_sec, 4)
                     if cfg.server.eval_every and (ridx + 1) % cfg.server.eval_every == 0:
                         record.update(self.evaluate(current_state["params"]))
+                for k in self._run_totals:
+                    if k in record:
+                        self._run_totals[k] += int(record[k])
                 self.logger.log(record)
             last_round = pending[-1][0] + 1
+            self._rounds_done = max(self._rounds_done, last_round)
             pending.clear()
+            if (self._ledger_on and self._ledger_cfg.log_every
+                    and self._ledger_ref is not None
+                    and last_round - self._ledger_logged_round
+                    >= self._ledger_cfg.log_every):
+                # periodic device-resident-ledger snapshot: one fetch
+                # per log_every rounds, at a flush boundary (the fetch
+                # is a few KB — never per round)
+                self._log_ledger(last_round)
             if self.health is not None and obs_cfg.params_check:
                 finite = all(
                     bool(jnp.isfinite(x).all())
@@ -1945,6 +2136,8 @@ class Experiment:
             for r in range(start_round, aligned):
                 with self.tracer.span("round"):
                     state = self.run_round(state, r, fuse_override=1)
+                if self._ledger_on:
+                    self._ledger_ref = state.get("ledger")
                 pending.append((r, state.pop("_metrics")))
             flush(state)
             start_round = aligned
@@ -1957,6 +2150,8 @@ class Experiment:
             try:
                 with self.tracer.span("round"):
                     state = self.run_round(state, r)
+                if self._ledger_on:
+                    self._ledger_ref = state.get("ledger")
                 ms = state.pop("_metrics")
                 if fuse == 1:
                     pending.append((r, ms))

@@ -37,6 +37,25 @@ _SECTIONS = [
      "export), communication/device counters, and NaN/divergence health "
      "monitoring with configurable abort. `colearn summarize <run>` "
      "aggregates the resulting JSONL into a per-phase timing table."),
+    ("run.obs.client_ledger", config_mod.ClientLedgerConfig,
+     "Per-client forensic ledger: each round program emits a [K] "
+     "per-client stats block (upload L2 norm, cosine vs the aggregated "
+     "delta, clip/EF residual magnitude, post-local-train loss, robust "
+     "median/MAD z-score anomaly flag) and scatters it in-program into "
+     "a device-resident [num_clients] store carried across rounds "
+     "(participation count, per-stat EMAs, cumulative flagged rounds) "
+     "— riding the fused scan carry under run.fuse_rounds like the EF "
+     "residual store, with zero extra host round-trips and an "
+     "unchanged params trajectory. Periodic `client_ledger` JSONL "
+     "records (final flush on EVERY exit path, aborts included) feed "
+     "`colearn clients <run>`: top-k anomalous clients, participation "
+     "histogram, and detection precision/recall against the attack "
+     "provenance event's ground-truth compromised set. Rejected "
+     "pairings with reasons: secure_aggregation (masking hides exactly "
+     "these statistics), client-level DP (a per-client disclosure "
+     "channel), gossip/fedbuff (no synchronous cohort upload stack), "
+     "scaffold/feddyn (stateful store plumbing). See docs/DESIGN.md "
+     "\"Client ledger & attack attribution\"."),
 ]
 
 # appended under the `attack` section table (kept here so the generated

@@ -388,3 +388,91 @@ def test_summary_tolerates_torn_tail_line(tmp_path):
     recs = load_records(str(p))
     assert len(recs) == 1
     assert summarize_records(recs)["rounds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# run_summary + summarize hardening + trace caps (r8 satellites)
+
+
+def test_run_summary_record_totals(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sharded")
+    _, _, recs, _ = _fit(cfg)
+    rs = [r for r in recs if r.get("event") == "run_summary"]
+    assert len(rs) == 1, "exactly one end-of-fit run_summary"
+    rs = rs[0]
+    rounds = [r for r in recs if "train_loss" in r]
+    assert rs["rounds"] == cfg.server.num_rounds
+    for k in ("upload_bytes", "upload_bytes_raw", "download_bytes",
+              "download_bytes_raw"):
+        assert rs[k] == sum(r.get(k, 0) for r in rounds), k
+    assert rs["wall_time_sec"] > 0
+    # the first dispatch compiled at least the round program
+    assert rs["compiles"] >= 1 and rs["compile_ms"] > 0
+
+
+def test_run_summary_lands_on_abort(tmp_path):
+    cfg = _tiny_cfg(tmp_path, "sequential", **{
+        "server.eval_every": 0, "client.lr": 1e38,
+        "run.obs.on_unhealthy": "abort", "run.metrics_flush_every": 1,
+    })
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(HealthAbortError):
+        exp.fit()
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, f"{cfg.name}.metrics.jsonl"))]
+    rs = [r for r in recs if r.get("event") == "run_summary"]
+    assert rs and rs[-1]["rounds"] >= 1  # partial totals still land
+
+
+def test_summarize_empty_log_clean_error(tmp_path, capsys):
+    p = tmp_path / "empty.metrics.jsonl"
+    p.write_text("")
+    assert cli.main(["summarize", str(p)]) == 2
+    err = capsys.readouterr().err
+    assert "no metrics records" in err and "Traceback" not in err
+    # an empty run DIRECTORY errors cleanly too (no *.metrics.jsonl)
+    d = tmp_path / "emptydir"
+    d.mkdir()
+    assert cli.main(["summarize", str(d)]) == 2
+    # and --json on a real run emits one parseable object
+    cfg = _tiny_cfg(tmp_path, "sequential", **{"server.eval_every": 0})
+    _, _, _, path = _fit(cfg)
+    assert cli.main(["summarize", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rounds"] == cfg.server.num_rounds and doc["path"] == path
+
+
+def test_trace_event_cap_truncates_and_warns_once(caplog):
+    import logging
+
+    clock = iter(float(t) for t in range(1000))
+    tracer = Tracer(enabled=True, trace=True, clock=lambda: next(clock),
+                    max_events=3)
+    with caplog.at_level(logging.WARNING):
+        for _ in range(6):
+            with tracer.span("s"):
+                pass
+    assert len(tracer._events) == 3  # capped
+    warns = [r for r in caplog.records if "trace event cap" in r.message]
+    assert len(warns) == 1  # warn-once
+    # span AGGREGATES keep counting past the cap
+    assert tracer.drain()["s"]["count"] == 6
+
+
+def test_trace_export_size_warning_once(tmp_path, caplog, monkeypatch):
+    import logging
+
+    from colearn_federated_learning_tpu.obs import spans as spans_mod
+
+    monkeypatch.setattr(spans_mod, "TRACE_SIZE_WARN_BYTES", 10)
+    clock = iter(float(t) for t in range(1000))
+    tracer = Tracer(enabled=True, trace=True, clock=lambda: next(clock))
+    with tracer.span("s"):
+        pass
+    with caplog.at_level(logging.WARNING):
+        tracer.export(str(tmp_path / "t1.json"))
+        tracer.export(str(tmp_path / "t2.json"))
+    warns = [r for r in caplog.records if "exported trace" in r.message]
+    assert len(warns) == 1  # warn-once per tracer
