@@ -337,6 +337,13 @@ def bench_config(name: str):
         # + scatter to every round — throughput numbers with it on are
         # not comparable to ledger-off pins, so record the switch
         "client_ledger": bool(cfg.run.obs.client_ledger.enabled),
+        # cohort-selection mode and reputation weighting (r8): adaptive
+        # sampling changes which clients (and so which shard shapes) the
+        # timed rounds draw, and reputation adds the in-program trust
+        # computation — both shift throughput semantics, so every result
+        # records them next to the ledger switch
+        "sampler": cfg.server.sampling,
+        "reputation": bool(cfg.server.reputation.enabled),
     }
     for k, v in overrides.items():
         extra[f"override:{k}"] = v

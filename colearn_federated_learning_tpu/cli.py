@@ -129,6 +129,11 @@ def build_parser():
     cl.add_argument("--json", action="store_true",
                     help="emit the report as one JSON object instead of "
                          "the table")
+    cl.add_argument("--threshold-sweep", action="store_true",
+                    help="also print detection precision/recall at "
+                         "several min-flag-rate cutoffs (requires an "
+                         "attack run), so the detection threshold can "
+                         "be picked without re-running")
     return p
 
 
@@ -167,13 +172,22 @@ def main(argv=None):
                     records, top_k=args.top,
                     min_flag_rate=args.min_flag_rate,
                 )
+                sweep = None
+                if args.threshold_sweep:
+                    sweep = obs_ledger.threshold_sweep(records)
             except ValueError as e:
                 print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
                 return 2
             if args.json:
+                if sweep is not None:
+                    report = dict(report, threshold_sweep=sweep)
                 print(json.dumps(dict(report, path=path)))
             else:
                 print(obs_ledger.format_clients_report(report, path))
+                if sweep is not None:
+                    print()
+                    print("detection threshold sweep:")
+                    print(obs_ledger.format_threshold_sweep(sweep))
             return 0
         agg = obs_summary.summarize_records(records)
         if args.json:

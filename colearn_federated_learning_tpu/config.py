@@ -88,6 +88,98 @@ class ClientConfig:
 
 
 @dataclass
+class ReputationConfig:
+    """Reputation-weighted aggregation (``server.reputation``,
+    server/aggregation.py ``reputation_weights``): the closed control
+    loop over the per-client forensic ledger. Each round program
+    converts every cohort member's ledger row — cumulative flag rate
+    ``flagged/count`` and the norm/cosine robust-z EMA — into a
+    multiplicative TRUST weight in ``[floor, 1]``:
+
+        score = flag_rate + z_gain * max(ema_z/zmax - 1, 0)
+        trust = floor + (1 - floor) * exp(-strength * score)
+
+    (unseen clients — ``count == 0`` — get trust exactly 1, so
+    reputation never suppresses a client before the ledger has
+    evidence). The trust is computed INSIDE the round program from the
+    device-resident ``[num_clients, LEDGER_WIDTH]`` ledger carried from
+    the PREVIOUS rounds (this round's stats update lands after
+    aggregation), so the single-psum weighted-mean path stays host-free
+    and under ``run.fuse_rounds`` the trust derives from the fused scan
+    carry. Where it applies:
+
+    - ``aggregator="weighted_mean"``: the FedAvg weight becomes
+      ``w_i · trust_i`` (numerator AND denominator — a true reweighted
+      mean; the reported ``train_loss`` is the same trust-weighted
+      mean). This is the soft complement to krum's hard rejection:
+      near ``f ≈ K/2`` krum's selection guarantee is void (the
+      Blanchard bound 2f+2 < n cannot be satisfied) while the
+      reputation-weighted mean degrades the attackers' mass gradually
+      as ledger evidence accumulates.
+    - robust aggregators (median/trimmed_mean/krum): order statistics
+      are unweighted by design, so trust instead SCALES each client's
+      delta (``trust_i · Δ_i``) before the reduction — a suppressed
+      client's upload shrinks toward the zero update rather than being
+      ejected, so false flags cost a fraction of one update instead of
+      a cohort slot.
+
+    Requires ``run.obs.client_ledger.enabled`` (trust is a function of
+    the ledger); the ledger's pairing exclusions (secure aggregation,
+    client-level DP, gossip/fedbuff, scaffold/feddyn) therefore apply
+    verbatim — see ClientLedgerConfig for the reasons. With
+    ``enabled=false`` (default) no trust input exists anywhere and runs
+    are bitwise-identical to pre-reputation builds."""
+
+    enabled: bool = False
+    # minimum trust weight: a fully-flagged client keeps this fraction
+    # of its voice (soft weighting — never a hard zero, so a falsely
+    # accused client can still earn its reputation back)
+    floor: float = 0.05
+    # exponential decay rate of trust in the anomaly score; flag_rate=1
+    # drives trust to ~floor + (1-floor)*exp(-strength)
+    strength: float = 6.0
+    # weight of the z-history term: only the part of the EMA'd robust z
+    # ABOVE the flag threshold (ema_z/zmax - 1) contributes, so honest
+    # clients' sub-threshold z noise never erodes their trust
+    z_gain: float = 1.0
+
+
+@dataclass
+class AdaptiveSamplerConfig:
+    """Knobs for ``server.sampling="adaptive"`` (server/sampler.py):
+    Oort-style utility-aware cohort selection (Lai et al., OSDI'21)
+    scored from the client ledger's periodic host-side snapshots. Per
+    client the score is
+
+        util      = ema_loss (unseen clients: the max seen utility —
+                    optimistic initialization, explore-eagerly)
+        staleness = 1 + staleness_gain * max(expected - count, 0)
+                    / max(expected, 1),  expected = round * K / N
+        score     = (util + eps) * staleness * exp(-flag_suppress
+                    * flag_rate)
+
+    and the draw probabilities are ``(1 - explore) * score/Σscore +
+    explore/N`` — the exploration floor keeps every client drawable
+    forever. The snapshot refreshes from the device-resident ledger at
+    ``run.obs.client_ledger.log_every`` round boundaries (one host
+    fetch per refresh, logged as the same ``client_ledger`` JSONL
+    record), so the cohort for round ``r`` is a pure function of
+    ``(seed, r, ledger_snapshot)`` and a resumed run replays the exact
+    straight-run schedule — the active snapshot rides the checkpoint.
+    See DataConfig/RunConfig pairing rejections in ``validate()``."""
+
+    # fraction of each draw's probability mass spread uniformly over
+    # ALL clients (the exploration floor; must be in (0, 1])
+    explore: float = 0.1
+    # boost for under-sampled clients (participation deficit vs the
+    # uniform expectation) — Oort's staleness/fairness term
+    staleness_gain: float = 1.0
+    # exponential suppression of high-flag-rate clients in the draw
+    # probabilities (the selection-side twin of reputation weighting)
+    flag_suppress: float = 4.0
+
+
+@dataclass
 class ServerConfig:
     num_rounds: int = 10
     cohort_size: int = 2
@@ -189,7 +281,13 @@ class ServerConfig:
     #              subsampled-Gaussian bound is EXACT (VERDICT r4
     #              missing-#3); under uniform/weighted it is an
     #              approximation (see dp_client_epsilon).
-    sampling: str = "uniform"  # uniform | weighted | poisson
+    #   adaptive — fixed-size, Oort-style utility-aware draw scored
+    #              from the client ledger's periodic snapshots (loss-
+    #              utility EMA × participation staleness, exploration
+    #              floor, flag-rate suppression — see
+    #              AdaptiveSamplerConfig / `server.adaptive`). Requires
+    #              run.obs.client_ledger.enabled with log_every >= 1.
+    sampling: str = "uniform"  # uniform | weighted | poisson | adaptive
     # Simulated client dropout: fraction of the sampled cohort whose
     # update is zeroed inside the round function (total failure).
     dropout_rate: float = 0.0
@@ -270,6 +368,13 @@ class ServerConfig:
     # uplink `compression` knob for the full comm-constrained story.
     downlink_compression: str = ""  # "" | qsgd
     downlink_qsgd_levels: int = 256
+    # Reputation-weighted aggregation off the client ledger — see
+    # ReputationConfig.
+    reputation: ReputationConfig = field(default_factory=ReputationConfig)
+    # sampling="adaptive" scoring knobs — see AdaptiveSamplerConfig.
+    adaptive: AdaptiveSamplerConfig = field(
+        default_factory=AdaptiveSamplerConfig
+    )
 
 
 @dataclass
@@ -779,7 +884,9 @@ class ExperimentConfig:
                 )
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
-        if self.server.sampling not in ("uniform", "weighted", "poisson"):
+        if self.server.sampling not in (
+            "uniform", "weighted", "poisson", "adaptive"
+        ):
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
         if (self.server.sampling == "poisson"
                 and self.server.secure_aggregation
@@ -1295,6 +1402,103 @@ class ExperimentConfig:
                     f"attack/robust stacks the ledger audits are "
                     f"rejected there anyway)"
                 )
+        rep = self.server.reputation
+        if not 0.0 < rep.floor < 1.0:
+            raise ValueError(
+                f"server.reputation.floor must be in (0, 1), got {rep.floor}"
+            )
+        if rep.strength <= 0.0:
+            raise ValueError(
+                f"server.reputation.strength must be > 0, "
+                f"got {rep.strength}"
+            )
+        if rep.z_gain < 0.0:
+            raise ValueError(
+                f"server.reputation.z_gain must be >= 0, got {rep.z_gain}"
+            )
+        if rep.enabled and not cl.enabled:
+            # trust weights are a pure function of the ledger rows; the
+            # ledger's own pairing rejections above (secure aggregation,
+            # client-level DP, gossip/fedbuff, scaffold/feddyn) therefore
+            # exclude exactly the combinations that would be unsound for
+            # reputation too — its stats channel IS the ledger's
+            raise ValueError(
+                "server.reputation requires run.obs.client_ledger."
+                "enabled (trust weights are computed from the "
+                "device-resident ledger rows; enabling the ledger also "
+                "applies its pairing exclusions — secagg, client-level "
+                "DP, gossip/fedbuff, stateful algorithms)"
+            )
+        if self.server.sampling == "adaptive":
+            ad = self.server.adaptive
+            if not 0.0 < ad.explore <= 1.0:
+                raise ValueError(
+                    f"server.adaptive.explore must be in (0, 1], "
+                    f"got {ad.explore}"
+                )
+            if ad.staleness_gain < 0.0:
+                raise ValueError(
+                    f"server.adaptive.staleness_gain must be >= 0, "
+                    f"got {ad.staleness_gain}"
+                )
+            if ad.flag_suppress < 0.0:
+                raise ValueError(
+                    f"server.adaptive.flag_suppress must be >= 0, "
+                    f"got {ad.flag_suppress}"
+                )
+            if not cl.enabled or cl.log_every < 1:
+                # the sampler's scores refresh from the periodic ledger
+                # snapshots; without a cadence they would stay frozen at
+                # the all-unseen prior forever
+                raise ValueError(
+                    "server.sampling='adaptive' requires "
+                    "run.obs.client_ledger.enabled with log_every >= 1 "
+                    "(utility scores refresh from the periodic ledger "
+                    "snapshots; the ledger's pairing exclusions apply)"
+                )
+            if self.run.fuse_rounds > 1 and cl.log_every % self.run.fuse_rounds:
+                # the ledger only materializes at chunk boundaries under
+                # fusion; a mid-chunk refresh round would have nothing
+                # deterministic to fetch
+                raise ValueError(
+                    f"server.sampling='adaptive' with run.fuse_rounds="
+                    f"{self.run.fuse_rounds} requires client_ledger."
+                    f"log_every ({cl.log_every}) to be a fuse_rounds "
+                    f"multiple (snapshot refreshes must land on fused-"
+                    f"chunk boundaries)"
+                )
+            if self.data.placement != "hbm":
+                # the stream-mode prefetch worker builds round r+1's
+                # inputs while round r runs; a snapshot refresh between
+                # build and consumption would sample a cohort a resumed
+                # run could not replay
+                raise ValueError(
+                    "server.sampling='adaptive' requires "
+                    "data.placement=hbm (the stream prefetch worker "
+                    "races the ledger-snapshot refresh, breaking the "
+                    "(seed, round, snapshot)-pure schedule)"
+                )
+            if self.run.shape_buckets.enabled:
+                # the bucket ladder's contract is that the cohort (and
+                # hence the rung) is a pure function of (seed, round) —
+                # adaptive cohorts additionally depend on the ledger
+                raise ValueError(
+                    "server.sampling='adaptive' is incompatible with "
+                    "run.shape_buckets (the bucket rung must be a pure "
+                    "function of (seed, round); adaptive cohorts depend "
+                    "on the ledger snapshot)"
+                )
+            if self.run.host_pipeline == "native":
+                # the C++ pipeline prefetches FUTURE rounds' cohorts and
+                # treats resubmission as a no-op — a snapshot refresh
+                # between prefetch and dispatch would silently serve
+                # tensors for a stale cohort ('auto' degrades to NumPy)
+                raise ValueError(
+                    "server.sampling='adaptive' is incompatible with "
+                    "run.host_pipeline='native' (the C++ pipeline "
+                    "prefetches future cohorts ahead of snapshot "
+                    "refreshes); use 'auto' or 'numpy'"
+                )
         return self
 
     # ---- serialization ------------------------------------------------
@@ -1328,6 +1532,8 @@ class ExperimentConfig:
             "obs": ObsConfig,  # nested under run
             "shape_buckets": ShapeBucketsConfig,  # nested under run
             "client_ledger": ClientLedgerConfig,  # nested under run.obs
+            "reputation": ReputationConfig,  # nested under server
+            "adaptive": AdaptiveSamplerConfig,  # nested under server
         }
         return build(cls, d)
 

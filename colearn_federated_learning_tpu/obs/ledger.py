@@ -281,6 +281,57 @@ def clients_report(records: List[Dict[str, Any]], top_k: int = 10,
     return report
 
 
+DEFAULT_SWEEP_THRESHOLDS = (0.1, 0.25, 0.5, 0.75, 0.9)
+
+
+def threshold_sweep(records: List[Dict[str, Any]],
+                    thresholds=DEFAULT_SWEEP_THRESHOLDS) -> List[Dict[str, Any]]:
+    """Detection precision/recall at several ``min-flag-rate`` cutoffs
+    from ONE run's JSONL — so an operator can pick the detection
+    threshold without re-running training (``colearn clients
+    --threshold-sweep``). Requires the run to carry an ``attack``
+    provenance event (without ground truth there is nothing to score
+    against — raises ValueError with that explanation). Each row:
+    ``{threshold, detected, true_positives, false_positives,
+    false_negatives, precision, recall}``."""
+    if not any(r.get("event") == "attack" for r in records):
+        raise ValueError(
+            "threshold sweep requires an attack provenance event in the "
+            "run log (precision/recall need the ground-truth compromised "
+            "set; benign runs have nothing to score against)"
+        )
+    rows = []
+    for t in thresholds:
+        rep = clients_report(records, top_k=0, min_flag_rate=float(t))
+        atk = rep["attack"]
+        rows.append({
+            "threshold": float(t),
+            "detected": len(atk["detected"]),
+            "true_positives": atk["true_positives"],
+            "false_positives": atk["false_positives"],
+            "false_negatives": atk["false_negatives"],
+            "precision": atk["precision"],
+            "recall": atk["recall"],
+        })
+    return rows
+
+
+def format_threshold_sweep(rows: List[Dict[str, Any]]) -> str:
+    """Render the sweep as an aligned text table."""
+    lines = [
+        f"{'min-flag-rate':>14}{'detected':>10}{'tp':>5}{'fp':>5}"
+        f"{'fn':>5}{'precision':>11}{'recall':>8}"
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['threshold']:>14.2f}{r['detected']:>10}"
+            f"{r['true_positives']:>5}{r['false_positives']:>5}"
+            f"{r['false_negatives']:>5}{r['precision']:>11.3f}"
+            f"{r['recall']:>8.3f}"
+        )
+    return "\n".join(lines)
+
+
 def format_clients_report(report: Dict[str, Any], path: str = "") -> str:
     """Render the clients report as an aligned text table."""
     lines = []

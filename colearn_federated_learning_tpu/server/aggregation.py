@@ -29,6 +29,59 @@ def weighted_delta_mean(deltas, weights):
     return trees.tree_weighted_mean(deltas, weights)
 
 
+def reputation_weights(ledger, cohort_ids, floor: float, strength: float,
+                       z_gain: float, zmax: float):
+    """``[K]`` multiplicative trust weights for one round's cohort from
+    the device-resident ``[num_clients, LEDGER_WIDTH]`` forensic ledger
+    (obs/ledger.py; ``server.reputation``). Per cohort member::
+
+        flag_rate = flagged / max(count, 1)
+        excess_z  = max(ema_z / zmax - 1, 0)      # above-threshold only
+        score     = flag_rate + z_gain * excess_z
+        trust     = floor + (1 - floor) * exp(-strength * score)
+
+    Unseen clients (``count == 0``) — and poisson pad slots, whose
+    out-of-range id makes ``take`` fill a zero row — get trust exactly
+    1.0: reputation only ever acts on ledger EVIDENCE, so round 1 is a
+    plain weighted mean and a fresh client enters at full voice. The
+    trust derives from the ledger AS CARRIED INTO the round (the
+    round's own stats scatter lands after aggregation), all in f32 with
+    one shared implementation for the sharded program, the sequential
+    oracle, and the fused scan body — cross-engine parity by
+    construction, exactly like ``client_round_stats``. Runs as plain
+    jnp under the round jit: zero extra host round-trips."""
+    rows = ledger.shape[0]
+    ids = jnp.where(
+        (cohort_ids >= 0) & (cohort_ids < rows),
+        cohort_ids.astype(jnp.int32), jnp.int32(rows),
+    )
+    row = jnp.take(ledger, ids, axis=0, mode="fill", fill_value=0.0)
+    count = row[:, 0]
+    flag_rate = row[:, 1] / jnp.maximum(count, 1.0)
+    excess_z = jnp.maximum(row[:, 6] / jnp.float32(zmax) - 1.0, 0.0)
+    score = flag_rate + jnp.float32(z_gain) * excess_z
+    trust = jnp.float32(floor) + jnp.float32(1.0 - floor) * jnp.exp(
+        -jnp.float32(strength) * score
+    )
+    return jnp.where(count > 0, trust, 1.0).astype(jnp.float32)
+
+
+def scale_deltas_by_trust(deltas, trust):
+    """Scale a ``[K, ...]`` stacked delta tree by per-client trust — the
+    reputation hook for the ROBUST aggregators, whose order statistics
+    are unweighted by design (a weighted median would re-open the
+    attack surface weights provide): a suppressed client's upload
+    shrinks toward the zero update instead of being hard-ejected, so a
+    false flag costs a fraction of one update rather than a cohort
+    slot. Shared by both engines."""
+    return jax.tree.map(
+        lambda d: d * trust.reshape(
+            (trust.shape[0],) + (1,) * (d.ndim - 1)
+        ).astype(d.dtype),
+        deltas,
+    )
+
+
 def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1,
                   byzantine_f: int = 0):
     """Byzantine-robust aggregate of stacked client deltas.

@@ -153,18 +153,23 @@ def apply_upload_attack(deltas, byz, keys, kind: str, scale: float,
     return jax.tree.map(leaf, deltas)
 
 
-def stack_weighted_mean(deltas, n_ex, mode: str, params):
+def stack_weighted_mean(deltas, n_ex, mode: str, params, trust=None):
     """FedAvg weighted mean over a ``[K, ...]`` stacked delta tree —
     the stacked-path twin of the engines' in-lane psum accumulation,
     used on attacked rounds (the attack transform needs the per-client
     stack, so the weighted mean runs after it). Identical jnp ops in
     both engines ⇒ attacked-round aggregation parity is exact given
     identical stacks. Result cast to the params dtype, matching the
-    psum path's accumulator."""
+    psum path's accumulator. ``trust``: optional ``[K]`` reputation
+    weights (server/aggregation.py ``reputation_weights``) folded
+    multiplicatively into the FedAvg weights — numerator and
+    denominator, a true reweighted mean."""
     w = (
         n_ex.astype(jnp.float32) if mode == "examples"
         else (n_ex > 0).astype(jnp.float32)
     )
+    if trust is not None:
+        w = w * trust.astype(jnp.float32)
     w_sum = w.sum()
     denom = jnp.where(w_sum > 0, w_sum, 1.0)
     return jax.tree.map(

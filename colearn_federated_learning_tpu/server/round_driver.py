@@ -97,12 +97,29 @@ class Experiment:
         # gossip and fedbuff keep the legacy full-mask inputs (their
         # engines consume it directly).
         self._spec_inputs = cfg.algorithm not in ("gossip", "fedbuff")
+        # Ledger-driven adaptive selection (server.sampling="adaptive"):
+        # the sampler scores clients Oort-style from periodic host-side
+        # ledger snapshots (loss-utility EMA × participation staleness,
+        # exploration floor, flag-rate suppression). The snapshot
+        # refreshes at client_ledger.log_every round boundaries (one
+        # blocking fetch each — see run_round) and rides the checkpoint
+        # (state["ledger_snapshot"]), so the schedule is a pure function
+        # of (seed, round, snapshot) and resume replays it exactly.
+        self._adaptive = cfg.server.sampling == "adaptive"
+        self._sampler_snapshot: Optional[np.ndarray] = None
+        self._sampler_snapshot_round = 0
         self.sampler = CohortSampler(
             self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
             weights=(
                 self.fed.client_sizes() if cfg.server.sampling == "weighted" else None
             ),
-            mode="poisson" if cfg.server.sampling == "poisson" else "fixed",
+            mode=(
+                "poisson" if cfg.server.sampling == "poisson"
+                else "adaptive" if self._adaptive else "fixed"
+            ),
+            explore=cfg.server.adaptive.explore,
+            staleness_gain=cfg.server.adaptive.staleness_gain,
+            flag_suppress=cfg.server.adaptive.flag_suppress,
         )
         # Poisson sampling: the realized Binomial(N, q) cohort is padded
         # to a STATIC cap of K + 5σ (so XLA never retraces); overflow
@@ -361,6 +378,10 @@ class Experiment:
                         client_ledger=self._ledger_on,
                         ledger_ema=lcfg.ema,
                         ledger_zmax=lcfg.zmax,
+                        reputation=cfg.server.reputation.enabled,
+                        rep_floor=cfg.server.reputation.floor,
+                        rep_strength=cfg.server.reputation.strength,
+                        rep_z_gain=cfg.server.reputation.z_gain,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
@@ -409,6 +430,10 @@ class Experiment:
                 client_ledger=self._ledger_on,
                 ledger_ema=lcfg.ema,
                 ledger_zmax=lcfg.zmax,
+                reputation=cfg.server.reputation.enabled,
+                rep_floor=cfg.server.reputation.floor,
+                rep_strength=cfg.server.reputation.strength,
+                rep_z_gain=cfg.server.reputation.z_gain,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -556,8 +581,14 @@ class Experiment:
                 and not self._poisson
                 # bucketed grids vary per round; the C++ pipeline builds
                 # ONE fixed shape (validate() rejects the explicit
-                # 'native' pairing; 'auto' degrades to NumPy here)
-                and self._bucket_ladder is None):
+                # 'native' pairing; 'auto' degrades to NumPy here).
+                # adaptive sampling: the pipeline prefetches FUTURE
+                # cohorts and treats resubmission as a no-op, so a
+                # ledger-snapshot refresh between prefetch and dispatch
+                # would silently serve a stale cohort's tensors
+                # (validate() rejects explicit 'native'; 'auto' degrades)
+                and self._bucket_ladder is None
+                and not self._adaptive):
             from colearn_federated_learning_tpu import native
 
             if native.available():
@@ -796,6 +827,19 @@ class Experiment:
             state["ledger"] = np.zeros(
                 (self.fed.num_clients, LEDGER_WIDTH), np.float32
             )
+        if self._adaptive:
+            # the adaptive sampler's ACTIVE ledger snapshot (host-side,
+            # refreshed at log_every round boundaries) rides the
+            # checkpoint so a resumed run scores rounds between
+            # snapshot boundaries exactly like the straight run did
+            from colearn_federated_learning_tpu.obs.ledger import (
+                LEDGER_WIDTH as _LW,
+            )
+
+            state["ledger_snapshot"] = np.zeros(
+                (self.fed.num_clients, _LW), np.float32
+            )
+            state["ledger_snapshot_round"] = 0
         if self.gossip:
             # every client starts at the same point (the standard
             # consensus init); the stack is host numpy until
@@ -895,6 +939,15 @@ class Experiment:
             state["ledger"] = self._put(
                 jnp.asarray(np.asarray(state["ledger"], np.float32)),
                 self._data_sharding,
+            )
+        if self._adaptive:
+            # the sampler snapshot stays HOST-side (the sampler is host
+            # code); a restored checkpoint hands back jax arrays
+            state["ledger_snapshot"] = np.asarray(
+                state["ledger_snapshot"], np.float32
+            )
+            state["ledger_snapshot_round"] = int(
+                np.asarray(state["ledger_snapshot_round"])
             )
         if self.gossip:
             # warm-start replicas from a previous fit() on this
@@ -1373,6 +1426,13 @@ class Experiment:
         that land off a chunk boundary (see _fit_body)."""
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
+        if (self._adaptive and round_idx > 0
+                and round_idx % self._ledger_cfg.log_every == 0):
+            # snapshot refresh BEFORE this round samples: the cohort for
+            # rounds [r, r + log_every) is a pure function of
+            # (seed, round, ledger@r) — round 0 keeps the all-unseen
+            # uniform prior (the zero snapshot init_state seeds)
+            self._refresh_adaptive_snapshot(round_idx)
         fuse = (
             self.cfg.run.fuse_rounds if fuse_override is None
             else fuse_override
@@ -1737,15 +1797,18 @@ class Experiment:
                 f"original algorithm/error_feedback settings"
             )
 
-    def _log_ledger(self, round_idx: int) -> None:
+    def _log_ledger(self, round_idx: int) -> Optional[np.ndarray]:
         """Emit one columnar `client_ledger` JSONL record from the
         device-resident ledger (rows with at least one participation).
-        Called at periodic flush boundaries and — via fit()'s finally —
+        Called at periodic flush boundaries, at the adaptive sampler's
+        snapshot refreshes (which consume the returned array — the
+        JSONL flush IS the sampler's feed), and — via fit()'s finally —
         on EVERY exit path, so aborted runs (HealthAbortError,
         KeyboardInterrupt, crashes) still land their partial ledger,
-        mirroring the trace-on-abort guarantee."""
+        mirroring the trace-on-abort guarantee. Returns the fetched
+        ``[num_clients, LEDGER_WIDTH]`` array (None when no ledger)."""
         if self._ledger_ref is None:
-            return
+            return None
         from colearn_federated_learning_tpu.obs.ledger import LEDGER_COLS
 
         led = np.asarray(jax.device_get(self._ledger_ref))
@@ -1764,6 +1827,31 @@ class Experiment:
             rec[col] = [round(float(v), 6) for v in led[active, j]]
         self.logger.log(rec)
         self._ledger_logged_round = int(round_idx)
+        return led
+
+    def _refresh_adaptive_snapshot(self, round_idx: int) -> None:
+        """Refresh the adaptive sampler's ledger snapshot at a
+        ``log_every`` round boundary: ONE blocking device fetch of the
+        ledger (the same fetch emits the periodic ``client_ledger``
+        JSONL record — the flush is the sampler's feed). The refresh
+        rounds are pure round arithmetic (multiples of log_every —
+        chunk boundaries under fuse_rounds, enforced by validate()), so
+        a resumed run refreshes at exactly the rounds the straight run
+        did; between refreshes the checkpointed snapshot covers it."""
+        if self._ledger_logged_round == round_idx:
+            # a flush boundary already logged (and fetched) this exact
+            # round — fetch without emitting a duplicate JSONL record
+            led = (
+                np.asarray(jax.device_get(self._ledger_ref))
+                if self._ledger_ref is not None else None
+            )
+        else:
+            led = self._log_ledger(round_idx)
+        if led is None:
+            return
+        self._sampler_snapshot = led
+        self._sampler_snapshot_round = int(round_idx)
+        self.sampler.observe_snapshot(led, round_idx)
 
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         caller_state = state is not None
@@ -1908,6 +1996,16 @@ class Experiment:
         state = self._place_state(state)
         if self._ledger_on:
             self._ledger_ref = state.get("ledger")
+        if self._adaptive:
+            # seed the sampler with the checkpoint's ACTIVE snapshot
+            # (zeros on a fresh run → the uniform all-unseen prior);
+            # refreshes at later log_every boundaries override it at
+            # exactly the rounds the straight run refreshed
+            self._sampler_snapshot = state["ledger_snapshot"]
+            self._sampler_snapshot_round = int(state["ledger_snapshot_round"])
+            self.sampler.observe_snapshot(
+                self._sampler_snapshot, self._sampler_snapshot_round
+            )
         start_round = int(state["round"])
         self._rounds_done = max(self._rounds_done, start_round)
         if start_round == 0 and self._poisson:
@@ -2138,6 +2236,11 @@ class Experiment:
                     state = self.run_round(state, r, fuse_override=1)
                 if self._ledger_on:
                     self._ledger_ref = state.get("ledger")
+                if self._adaptive:
+                    state["ledger_snapshot"] = self._sampler_snapshot
+                    state["ledger_snapshot_round"] = (
+                        self._sampler_snapshot_round
+                    )
                 pending.append((r, state.pop("_metrics")))
             flush(state)
             start_round = aligned
@@ -2152,6 +2255,14 @@ class Experiment:
                     state = self.run_round(state, r)
                 if self._ledger_on:
                     self._ledger_ref = state.get("ledger")
+                if self._adaptive:
+                    # the ACTIVE snapshot rides every checkpoint so a
+                    # resume scores mid-window rounds exactly like the
+                    # straight run (run_round returns a fresh dict)
+                    state["ledger_snapshot"] = self._sampler_snapshot
+                    state["ledger_snapshot_round"] = (
+                        self._sampler_snapshot_round
+                    )
                 ms = state.pop("_metrics")
                 if fuse == 1:
                     pending.append((r, ms))

@@ -1,32 +1,83 @@
 """Cohort sampler (SURVEY.md §2 C4).
 
-Stateless-by-construction: the cohort for round ``r`` is a pure function
-of ``(seed, r)`` — resume after checkpoint restore replays the exact
-same schedule with no sampler state to persist (SURVEY.md §5
-checkpoint/resume).
+Stateless-by-construction for the classic modes: the cohort for round
+``r`` is a pure function of ``(seed, r)`` — resume after checkpoint
+restore replays the exact same schedule with no sampler state to
+persist (SURVEY.md §5 checkpoint/resume).
+
+``mode="adaptive"`` (Oort-style utility-aware selection, Lai et al.
+OSDI'21; ``server.sampling="adaptive"``) relaxes that to *pure in
+``(seed, r, ledger_snapshot)``*: the draw probabilities are a
+deterministic function of the last client-ledger snapshot observed via
+:meth:`observe_snapshot`, and the snapshot itself refreshes only at
+fixed round boundaries (``run.obs.client_ledger.log_every`` multiples,
+driven by the round driver) and rides the checkpoint — so a resumed
+run still replays the straight run's schedule exactly, including
+through a snapshot boundary (test-pinned).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
+
+# ledger column indices the adaptive score reads (obs/ledger.py
+# LEDGER_COLS order: count, flagged, ema_l2, ema_cos, ema_resid,
+# ema_loss, ema_z)
+_COUNT, _FLAGGED, _EMA_LOSS = 0, 1, 5
 
 
 class CohortSampler:
     def __init__(self, num_clients: int, cohort_size: int, seed: int,
                  weights: np.ndarray | None = None,
-                 mode: str = "fixed"):
+                 mode: str = "fixed",
+                 explore: float = 0.1,
+                 staleness_gain: float = 1.0,
+                 flag_suppress: float = 4.0):
         if cohort_size > num_clients:
             raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
-        if mode not in ("fixed", "poisson"):
+        if mode not in ("fixed", "poisson", "adaptive"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         self.num_clients = num_clients
         self.cohort_size = cohort_size
         self.seed = seed
         self.mode = mode
+        self.explore = float(explore)
+        self.staleness_gain = float(staleness_gain)
+        self.flag_suppress = float(flag_suppress)
+        # adaptive: the last observed ledger snapshot (None until the
+        # driver feeds one — the all-unseen prior is a uniform draw)
+        self.snapshot_round: int = 0
         if weights is not None:
-            if mode == "poisson":
-                raise ValueError("poisson sampling is unweighted (q = K/N)")
+            if mode in ("poisson", "adaptive"):
+                raise ValueError(
+                    "static weights only apply to mode='fixed' (poisson "
+                    "is unweighted q = K/N; adaptive derives its own "
+                    "scores from the ledger)"
+                )
             w = np.asarray(weights, np.float64)
+            # a silent NaN here used to surface rounds later as an
+            # opaque rng.choice "probabilities do not sum to 1" error —
+            # reject the malformed weights where they enter instead
+            if w.shape != (num_clients,):
+                raise ValueError(
+                    f"sampler weights shape {w.shape} != ({num_clients},)"
+                )
+            if not np.all(np.isfinite(w)):
+                raise ValueError(
+                    "sampler weights must be finite (got NaN/Inf entries)"
+                )
+            if (w < 0).any():
+                raise ValueError(
+                    f"sampler weights must be non-negative "
+                    f"(min {w.min():.3g})"
+                )
+            if w.sum() <= 0.0:
+                raise ValueError(
+                    "sampler weights sum to zero — every client would "
+                    "have an undefined draw probability"
+                )
             self.probs = w / w.sum()
         else:
             self.probs = None
@@ -35,6 +86,68 @@ class CohortSampler:
     def q(self) -> float:
         """Per-client per-round participation probability (poisson)."""
         return self.cohort_size / self.num_clients
+
+    # ---- adaptive scoring (mode="adaptive") --------------------------
+
+    def observe_snapshot(self, ledger: Optional[np.ndarray],
+                         round_idx: int) -> None:
+        """Refresh the adaptive draw probabilities from a host-side
+        ledger snapshot (``[num_clients, LEDGER_WIDTH]``; None resets
+        to the uniform prior). Deterministic: the same (snapshot,
+        round) always yields the same probabilities, so the schedule
+        stays replayable across resume."""
+        if self.mode != "adaptive":
+            raise ValueError(
+                f"observe_snapshot only applies to mode='adaptive' "
+                f"(this sampler is {self.mode!r})"
+            )
+        self.snapshot_round = int(round_idx)
+        if ledger is None:
+            self.probs = None
+            return
+        led = np.asarray(ledger, np.float64)
+        if led.shape[0] != self.num_clients:
+            raise ValueError(
+                f"ledger snapshot has {led.shape[0]} rows, sampler "
+                f"tracks {self.num_clients} clients"
+            )
+        self.probs = self._adaptive_probs(led, self.snapshot_round)
+
+    def _adaptive_probs(self, led: np.ndarray,
+                        snap_round: int) -> Optional[np.ndarray]:
+        """Oort-style scores → draw probabilities. Per client:
+        loss-utility EMA (unseen clients take the max seen utility —
+        optimistic initialization, so exploration is eager rather than
+        starved) × a participation-staleness boost (deficit vs the
+        uniform expectation ``round·K/N``) × exponential suppression of
+        high-flag-rate clients; then the exploration floor mixes
+        ``explore/N`` uniformly so no client's probability ever reaches
+        zero."""
+        count = led[:, _COUNT]
+        seen = count > 0
+        if not seen.any():
+            return None  # all-unseen prior: uniform draw
+        util = np.where(seen, np.maximum(led[:, _EMA_LOSS], 0.0), 0.0)
+        max_seen = float(util[seen].max())
+        util = np.where(seen, util, max(max_seen, 1e-6))
+        flag_rate = np.where(seen, led[:, _FLAGGED] / np.maximum(count, 1.0),
+                             0.0)
+        expected = snap_round * self.cohort_size / self.num_clients
+        deficit = np.maximum(expected - count, 0.0)
+        staleness = 1.0 + self.staleness_gain * deficit / max(expected, 1.0)
+        score = (
+            (util + 1e-6) * staleness * np.exp(-self.flag_suppress * flag_rate)
+        )
+        total = score.sum()
+        if not np.isfinite(total) or total <= 0.0:
+            return None
+        probs = (
+            (1.0 - self.explore) * score / total
+            + self.explore / self.num_clients
+        )
+        return probs / probs.sum()  # exact renormalization for rng.choice
+
+    # ------------------------------------------------------------------
 
     def sample(self, round_idx: int) -> np.ndarray:
         rng = np.random.default_rng((self.seed, round_idx))

@@ -18,6 +18,40 @@ _SECTIONS = [
     ("client", config_mod.ClientConfig, "Per-client local training."),
     ("server", config_mod.ServerConfig,
      "Round schedule, aggregation, algorithms' server-side knobs."),
+    ("server.reputation", config_mod.ReputationConfig,
+     "Reputation-weighted aggregation off the per-client forensic "
+     "ledger: each round converts every cohort member's ledger row "
+     "(cumulative flag rate, above-threshold robust-z EMA) into a "
+     "multiplicative trust weight in [floor, 1], computed IN-PROGRAM "
+     "from the device-resident ledger carried from previous rounds — "
+     "the single-psum weighted-mean path stays host-free and the "
+     "trust rides the fused scan carry under run.fuse_rounds. Under "
+     "aggregator=weighted_mean the FedAvg weight becomes w*trust "
+     "(numerator and denominator); under robust aggregators trust "
+     "scales each delta before the order statistics (soft suppression "
+     "— a false flag costs a fraction of one update, not a cohort "
+     "slot). Unseen clients carry trust exactly 1. This is the soft "
+     "complement to krum's hard rejection: near f = K/2 the Blanchard "
+     "resilience bound is void, while the reputation-weighted mean "
+     "degrades attackers gradually as ledger evidence accumulates "
+     "(test-pinned: sign_flip at f = K/2 - 1 on cohort 8 breaks both "
+     "plain weighted_mean and krum; the reputation-weighted mean "
+     "stays in the benign band). Requires run.obs.client_ledger."
+     "enabled (and inherits its pairing exclusions). See "
+     "docs/DESIGN.md \"Adaptive selection & reputation\"."),
+    ("server.adaptive", config_mod.AdaptiveSamplerConfig,
+     "Scoring knobs for server.sampling=\"adaptive\": Oort-style "
+     "utility-aware cohort selection from the ledger's periodic "
+     "host-side snapshots — loss-utility EMA x participation-"
+     "staleness boost x exponential flag-rate suppression, mixed with "
+     "a uniform exploration floor so every client stays drawable. The "
+     "snapshot refreshes at client_ledger.log_every round boundaries "
+     "and rides the checkpoint, so the schedule is a pure function of "
+     "(seed, round, snapshot) and resume replays it exactly. Requires "
+     "run.obs.client_ledger.enabled with log_every >= 1; rejected "
+     "with data.placement=stream, run.shape_buckets, and "
+     "run.host_pipeline='native' (each would race or stale the "
+     "snapshot — see config.py for the reasons)."),
     ("dp", config_mod.DPConfig, "DP-SGD (per-example clip + noise, RDP accounting)."),
     ("attack", config_mod.AttackConfig,
      "Byzantine adversary simulation (in-loop attack injection)."),

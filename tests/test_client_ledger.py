@@ -325,6 +325,51 @@ def test_clients_report_and_cli(tmp_path, capsys):
                      "--out-dir", str(tmp_path / "nope")]) == 2
 
 
+def test_threshold_sweep_and_cli_flag(tmp_path, capsys):
+    """`colearn clients --threshold-sweep`: precision/recall at several
+    min-flag-rate cutoffs from one run's JSONL, so operators pick the
+    detection threshold without re-running training."""
+    from colearn_federated_learning_tpu.obs.ledger import (
+        DEFAULT_SWEEP_THRESHOLDS,
+        format_threshold_sweep,
+        threshold_sweep,
+    )
+
+    cfg = _cfg(tmp_path, "sharded", rounds=6, **{
+        "attack.kind": "sign_flip", "attack.fraction": 0.25,
+    })
+    _fit(cfg)
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    recs = [json.loads(l) for l in open(path)]
+    rows = threshold_sweep(recs)
+    assert len(rows) == len(DEFAULT_SWEEP_THRESHOLDS)
+    for r in rows:
+        assert set(r) == {"threshold", "detected", "true_positives",
+                          "false_positives", "false_negatives",
+                          "precision", "recall"}
+    # monotone by construction: raising the threshold never detects MORE
+    dets = [r["detected"] for r in rows]
+    assert dets == sorted(dets, reverse=True), dets
+    text = format_threshold_sweep(rows)
+    assert "min-flag-rate" in text and "precision" in text
+    # CLI: table + --json carry the sweep
+    assert cli.main(["clients", path, "--threshold-sweep"]) == 0
+    out = capsys.readouterr().out
+    assert "detection threshold sweep" in out
+    assert cli.main(["clients", path, "--threshold-sweep", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["threshold_sweep"]) == len(DEFAULT_SWEEP_THRESHOLDS)
+    # a benign run has no ground truth to sweep against: clean error
+    benign = _cfg(tmp_path / "benign")
+    _fit(benign)
+    bpath = os.path.join(
+        str(tmp_path / "benign"), f"{benign.name}.metrics.jsonl"
+    )
+    assert cli.main(["clients", bpath, "--threshold-sweep"]) == 2
+    err = capsys.readouterr().err
+    assert "attack" in err and "Traceback" not in err
+
+
 def test_clients_cli_errors_without_ledger(tmp_path, capsys):
     p = tmp_path / "x.metrics.jsonl"
     p.write_text('{"round": 1, "train_loss": 1.0, "schema": 1}\n')

@@ -1,0 +1,370 @@
+"""Reputation-weighted aggregation (server.reputation,
+server/aggregation.py reputation_weights): trust-weight semantics, the
+reputation-off bitwise-identity contract, engine/fusion parity per
+aggregator × attack with reputation ON, config/engine pairing
+rejections, and THE headline robustness smoke — sign_flip at
+f = K/2 − 1 of cohort 8 (beyond krum's Blanchard resilience bound)
+breaks both plain weighted_mean and krum while the reputation-weighted
+mean holds the benign convergence band."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.obs.ledger import LEDGER_WIDTH
+from colearn_federated_learning_tpu.server.aggregation import (
+    reputation_weights,
+    scale_deltas_by_trust,
+)
+
+# ---------------------------------------------------------------------------
+# unit: trust-weight semantics
+# ---------------------------------------------------------------------------
+
+
+def _trust(led, ids, floor=0.05, strength=6.0, z_gain=1.0, zmax=3.5):
+    return np.asarray(reputation_weights(
+        jnp.asarray(led, jnp.float32), jnp.asarray(ids, jnp.int32),
+        floor, strength, z_gain, zmax,
+    ))
+
+
+def test_trust_is_one_without_evidence_and_floor_when_fully_flagged():
+    led = np.zeros((4, LEDGER_WIDTH), np.float32)
+    led[1] = [10, 10, 5.0, -0.9, 0.0, 2.5, 20.0]  # persistent attacker
+    led[2] = [10, 0, 0.5, 0.9, 0.0, 2.5, 0.3]     # clean history
+    tr = _trust(led, [0, 1, 2, 3])
+    assert tr[0] == 1.0  # unseen: full voice (no evidence)
+    assert tr[3] == 1.0
+    # fully flagged + huge z-history: trust collapses to ~floor
+    assert tr[1] == pytest.approx(0.05, abs=0.005)
+    # clean history: score 0 exactly (sub-threshold z never erodes
+    # trust) => trust = floor + (1 - floor)
+    assert tr[2] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_trust_z_history_contributes_only_above_threshold():
+    led = np.zeros((2, LEDGER_WIDTH), np.float32)
+    led[0] = [10, 0, 1.0, 0.5, 0.0, 2.0, 3.4]  # z-EMA just below zmax
+    led[1] = [10, 0, 1.0, 0.5, 0.0, 2.0, 7.0]  # z-EMA = 2x zmax
+    tr = _trust(led, [0, 1])
+    assert tr[0] == pytest.approx(1.0, abs=1e-6)
+    assert tr[1] < 0.1  # excess_z = 1 -> exp(-6) territory
+
+
+def test_trust_oob_ids_get_full_voice():
+    # poisson pad slots (id == rows) and any OOB id hit take's zero
+    # fill -> count 0 -> trust 1 (they carry zero weight anyway)
+    led = np.zeros((2, LEDGER_WIDTH), np.float32)
+    led[:, 0] = 5.0
+    led[:, 1] = 5.0
+    tr = _trust(led, [0, 1, 2, 7])
+    assert tr[2] == 1.0 and tr[3] == 1.0
+    assert tr[0] < 0.1 and tr[1] < 0.1
+
+
+def test_scale_deltas_by_trust_scales_rows():
+    d = {"w": jnp.ones((3, 4), jnp.float32)}
+    out = np.asarray(scale_deltas_by_trust(
+        d, jnp.asarray([1.0, 0.5, 0.0], jnp.float32))["w"])
+    np.testing.assert_allclose(out[0], 1.0)
+    np.testing.assert_allclose(out[1], 0.5)
+    np.testing.assert_allclose(out[2], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# config / engine pairing rejections
+# ---------------------------------------------------------------------------
+
+
+def test_reputation_requires_ledger():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.server.reputation.enabled = True
+    with pytest.raises(ValueError, match="client_ledger"):
+        cfg.validate()
+    cfg.run.obs.client_ledger.enabled = True
+    cfg.validate()  # ledger on: fine
+
+
+@pytest.mark.parametrize("key,value,match", [
+    ("floor", 0.0, "floor"),
+    ("floor", 1.0, "floor"),
+    ("strength", 0.0, "strength"),
+    ("z_gain", -1.0, "z_gain"),
+])
+def test_reputation_knob_ranges(key, value, match):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.run.obs.client_ledger.enabled = True
+    cfg.server.reputation.enabled = True
+    setattr(cfg.server.reputation, key, value)
+    with pytest.raises(ValueError, match=match):
+        cfg.validate()
+
+
+def test_engine_compat_mirror_rejects_reputation_without_ledger():
+    from colearn_federated_learning_tpu.config import (
+        ClientConfig,
+        DPConfig,
+        ServerConfig,
+    )
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+    )
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+
+    _, update = make_server_update_fn(ServerConfig(cohort_size=4))
+    with pytest.raises(ValueError, match="reputation.*ledger"):
+        make_sequential_round_fn(
+            None, ClientConfig(), DPConfig(), "classify", update,
+            reputation=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# driver e2e: off-identity + engine/fusion parity with reputation ON
+# ---------------------------------------------------------------------------
+
+
+def _cfg(out, engine="sharded", fuse=1, rounds=4, **over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": rounds, "server.eval_every": 0,
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 32, "client.batch_size": 16,
+        "run.out_dir": str(out), "run.metrics_flush_every": 2,
+        "run.engine": engine, "run.fuse_rounds": fuse,
+        "run.obs.client_ledger.enabled": True,
+        "server.reputation.enabled": True,
+        **over,
+    })
+    return cfg.validate()
+
+
+def _fit(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    return exp, exp.fit()
+
+
+def _params_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)),
+        a, b,
+    )
+
+
+def test_reputation_off_is_bitwise_identical_to_baseline(tmp_path):
+    """The off-switch contract: server.reputation.enabled=false builds
+    exactly the pre-reputation program (no trust input exists anywhere),
+    so a ledger-on reputation-off run is bitwise the ledger-on run."""
+    cfg_off = _cfg(tmp_path / "off")
+    cfg_off.server.reputation.enabled = False
+    _, off = _fit(cfg_off)
+    cfg_base = _cfg(tmp_path / "base")
+    cfg_base.server.reputation.enabled = False
+    cfg_base.run.obs.client_ledger.enabled = False
+    _, base = _fit(cfg_base)
+    _params_equal(off["params"], base["params"])
+
+
+_MATRIX = [
+    ("weighted_mean", ""),
+    ("weighted_mean", "sign_flip"),
+    ("krum", ""),
+    ("krum", "sign_flip"),
+]
+
+
+@pytest.mark.parametrize("aggregator,attack", _MATRIX)
+def test_reputation_parity_engines_and_fusion(tmp_path, aggregator, attack):
+    """The acceptance matrix with reputation ON: fused↔unfused params
+    BITWISE (the trust computation fuses into the scan body), and
+    sharded↔sequential at the engines' established cross-engine float
+    tolerance."""
+    over = {"server.aggregator": aggregator}
+    if attack:
+        over.update({"attack.kind": attack, "attack.fraction": 0.25})
+    _, sh = _fit(_cfg(tmp_path / "sh", "sharded", **over))
+    _, fu = _fit(_cfg(tmp_path / "fu", "sharded", fuse=2, **over))
+    _, sq = _fit(_cfg(tmp_path / "sq", "sequential", **over))
+    _params_equal(sh["params"], fu["params"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-4),
+        sh["params"], sq["params"],
+    )
+    # the ledgers agree too (count/flag exact — same contract as the
+    # ledger parity suite)
+    led_sh = np.asarray(jax.device_get(sh["ledger"]))
+    led_sq = np.asarray(jax.device_get(sq["ledger"]))
+    np.testing.assert_array_equal(led_sh[:, :2], led_sq[:, :2])
+
+
+def test_reputation_suppresses_poisoned_history_single_round():
+    """One engine-level round with a pre-poisoned ledger row: the
+    flagged attacker's sign-flipped upload must move params measurably
+    less with reputation on than off — the trust weight acts before
+    aggregation, inside the program."""
+    from colearn_federated_learning_tpu.config import (
+        ClientConfig,
+        DPConfig,
+        ServerConfig,
+    )
+    from colearn_federated_learning_tpu.models import build_model, init_params
+    from colearn_federated_learning_tpu.parallel.round_engine import (
+        make_sequential_round_fn,
+    )
+    from colearn_federated_learning_tpu.server.aggregation import (
+        make_server_update_fn,
+    )
+
+    model = build_model("lenet5", num_classes=10)
+    params = init_params(model, (28, 28, 1), seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (64, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+    k = 4
+    idx = jnp.asarray(rng.integers(0, 64, (k, 2, 8)).astype(np.int32))
+    mask = jnp.ones((k, 2, 8), jnp.float32)
+    n_ex = jnp.full((k,), 16.0, jnp.float32)
+    byz = jnp.asarray([0.0, 1.0, 0.0, 0.0], jnp.float32)
+    ledger = np.zeros((k, LEDGER_WIDTH), np.float32)
+    ledger[1] = [5, 5, 9.0, -1.0, 0.0, 2.3, 12.0]  # the attacker's record
+    ids = jnp.arange(k, dtype=jnp.int32)
+    sinit, supdate = make_server_update_fn(ServerConfig(optimizer="mean"))
+    ccfg = ClientConfig(batch_size=8, lr=0.1, momentum=0.0)
+
+    moved = {}
+    for rep_on in (False, True):
+        fn = make_sequential_round_fn(
+            model, ccfg, DPConfig(), "classify", supdate,
+            attack="sign_flip", attack_scale=10.0, client_ledger=True,
+            reputation=rep_on,
+        )
+        p, _, led_out, _ = fn(
+            params, sinit(params), x, y, idx, mask, n_ex,
+            jax.random.PRNGKey(3), byz=byz,
+            ledger=jnp.asarray(ledger), ledger_ids=ids,
+        )
+        moved[rep_on] = sum(
+            float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+            for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(params))
+        )
+        # the ledger still observed the RAW wire upload (trust must not
+        # launder the forensics): the attacker's row was updated
+        led_h = np.asarray(led_out)
+        assert led_h[1, 0] == 6.0
+    assert moved[True] < 0.5 * moved[False], moved
+
+
+# ---------------------------------------------------------------------------
+# THE headline smoke: sign_flip at f = K/2 - 1 — krum and the plain
+# mean break, the reputation-weighted mean holds the benign band
+# ---------------------------------------------------------------------------
+
+
+def _headline_cfg(out, name, **over):
+    """8-client federation at full participation (cohort 8) under
+    Dirichlet skew, sign_flip at fraction 3/8 => exactly f = 3 =
+    K/2 - 1 compromised slots every round — beyond krum's resilience
+    bound (2f + 2 < K admits at most f = 2), which is the regime this
+    PR exists for."""
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.name = name
+    cfg.apply_overrides({
+        "server.num_rounds": 40, "server.eval_every": 0,
+        "data.num_clients": 8, "server.cohort_size": 8,
+        "data.partition": "dirichlet", "data.dirichlet_alpha": 2.5,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 32, "client.batch_size": 8,
+        "run.out_dir": str(out), "run.metrics_flush_every": 8,
+        **over,
+    })
+    return cfg.validate()
+
+
+def _fit_loss(tmp_path, name, **over):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(_headline_cfg(tmp_path, name, **over), echo=False)
+    state = exp.fit()
+    ev = exp.evaluate(state["params"])
+    return exp, state, ev
+
+
+# the benign convergence band for this config: the benign weighted mean
+# lands at eval_loss ~0.009; anything under BAND is "converged", and
+# both broken legs sit far outside it (measured: mean ~1.6e3, krum ~2.4)
+_BENIGN_BAND = 0.5
+
+
+def test_headline_reputation_holds_where_krum_and_mean_break(tmp_path):
+    """THE acceptance story (ISSUE 6): under sign_flip at f = K/2 − 1
+    — past krum's breakdown point — the reputation-weighted mean keeps
+    final eval loss within the benign convergence band while plain
+    weighted_mean diverges and krum collapses out of it; and the
+    in-program anomaly flags that drive the trust weights detect the
+    ground-truth compromised set."""
+    import json
+    import os
+
+    from colearn_federated_learning_tpu.obs.ledger import (
+        clients_report,
+        threshold_sweep,
+    )
+
+    attack = {"attack.kind": "sign_flip", "attack.fraction": 0.375,
+              "attack.scale": 3.0}
+
+    _, _, benign = _fit_loss(tmp_path, "benign_mean")
+    assert benign["eval_loss"] < _BENIGN_BAND / 5, benign
+
+    _, _, mean_atk = _fit_loss(tmp_path, "atk_mean", **attack)
+    assert mean_atk["eval_loss"] > 10 * _BENIGN_BAND, (
+        f"plain weighted_mean survived f = K/2 - 1: {mean_atk}"
+    )
+
+    _, _, krum_atk = _fit_loss(
+        tmp_path, "atk_krum", **attack,
+        **{"server.aggregator": "krum", "server.krum_byzantine": 2},
+    )
+    assert krum_atk["eval_loss"] > 2 * _BENIGN_BAND, (
+        f"krum unexpectedly held past its resilience bound: {krum_atk}"
+    )
+
+    exp, state, rep = _fit_loss(
+        tmp_path, "atk_rep", **attack,
+        **{"run.obs.client_ledger.enabled": True,
+           "server.reputation.enabled": True},
+    )
+    assert rep["eval_loss"] < _BENIGN_BAND, (
+        f"reputation-weighted mean left the benign band: {rep} "
+        f"(benign {benign})"
+    )
+    assert rep["eval_acc"] > 0.9, rep
+
+    # the trust weights really did the work: every compromised client's
+    # ledger row is heavily flagged, no honest client's is
+    led = np.asarray(jax.device_get(state["ledger"]))
+    byz = np.asarray(exp.compromised)
+    assert len(byz) == 3
+    rate = led[:, 1] / np.maximum(led[:, 0], 1.0)
+    assert (rate[byz] > 0.5).all(), rate
+    honest = np.setdiff1d(np.arange(8), byz)
+    assert (rate[honest] < 0.1).all(), rate
+    # and the report/threshold-sweep surface it (precision & recall 1.0
+    # at the default threshold on this config)
+    path = os.path.join(str(tmp_path), "atk_rep.metrics.jsonl")
+    recs = [json.loads(l) for l in open(path)]
+    atk_rep = clients_report(recs)["attack"]
+    assert atk_rep["precision"] >= 0.99 and atk_rep["recall"] >= 0.99
+    rows = threshold_sweep(recs)
+    assert any(r["precision"] == 1.0 and r["recall"] == 1.0 for r in rows)

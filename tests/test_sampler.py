@@ -31,6 +31,219 @@ def test_cohort_too_big_rejected():
         CohortSampler(num_clients=4, cohort_size=5, seed=0)
 
 
+@pytest.mark.parametrize("weights,match", [
+    (np.array([1.0, np.nan, 1.0, 1.0]), "finite"),
+    (np.array([1.0, np.inf, 1.0, 1.0]), "finite"),
+    (np.array([1.0, -2.0, 1.0, 1.0]), "non-negative"),
+    (np.zeros(4), "zero"),
+    (np.ones(3), "shape"),
+])
+def test_malformed_weights_rejected_with_clear_error(weights, match):
+    """w / w.sum() used to silently produce NaN probabilities that
+    surfaced rounds later as an opaque rng.choice error — malformed
+    weights must be rejected where they enter, with the reason."""
+    with pytest.raises(ValueError, match=match):
+        CohortSampler(num_clients=4, cohort_size=2, seed=0, weights=weights)
+
+
+def test_static_weights_rejected_for_poisson_and_adaptive():
+    for mode in ("poisson", "adaptive"):
+        with pytest.raises(ValueError, match="fixed"):
+            CohortSampler(num_clients=4, cohort_size=2, seed=0,
+                          weights=np.ones(4), mode=mode)
+
+
+# ---------------------------------------------------------------------------
+# adaptive mode (server.sampling="adaptive"): Oort-style ledger scoring
+# ---------------------------------------------------------------------------
+
+
+def _ledger(num_clients, count=None, flagged=None, ema_loss=None):
+    led = np.zeros((num_clients, 7), np.float32)
+    if count is not None:
+        led[:, 0] = count
+    if flagged is not None:
+        led[:, 1] = flagged
+    if ema_loss is not None:
+        led[:, 5] = ema_loss
+    return led
+
+
+def test_adaptive_uniform_prior_and_snapshot_determinism():
+    s = CohortSampler(8, 4, seed=0, mode="adaptive")
+    a = s.sample(3)
+    assert s.probs is None  # all-unseen prior: uniform draw
+    led = _ledger(8, count=4, ema_loss=np.linspace(1.0, 3.0, 8))
+    s.observe_snapshot(led, 10)
+    b1 = s.sample(3)
+    # same (seed, round, snapshot) => same cohort, every time
+    s.observe_snapshot(led, 10)
+    np.testing.assert_array_equal(b1, s.sample(3))
+    assert len(np.unique(b1)) == 4
+    # a different snapshot changes the draw distribution (vs uniform)
+    assert s.probs is not None and not np.allclose(s.probs, 1.0 / 8)
+    del a
+
+
+def test_adaptive_prefers_high_loss_and_suppresses_flagged():
+    n, k, rounds = 16, 4, 800
+    # clients 0-3: high loss (useful); 12-15: flagged attackers
+    loss = np.full(n, 1.0)
+    loss[:4] = 4.0
+    flagged = np.zeros(n)
+    flagged[12:] = 10.0
+    led = _ledger(n, count=10, flagged=flagged, ema_loss=loss)
+    s = CohortSampler(n, k, seed=0, mode="adaptive")
+    s.observe_snapshot(led, 20)
+    hits = np.zeros(n)
+    for r in range(rounds):
+        hits[s.sample(r)] += 1
+    # high-utility clients dominate; flagged clients are suppressed to
+    # near the exploration floor but NEVER to zero
+    assert hits[:4].mean() > 2 * hits[4:12].mean(), hits
+    assert hits[:4].mean() > 3 * hits[12:].mean(), hits
+    assert (hits[12:] > 0).all(), "exploration floor starved a client"
+
+
+def test_adaptive_staleness_boosts_undersampled_clients():
+    n, k = 16, 4
+    count = np.full(n, 20.0)
+    count[5] = 1.0  # heavily under-sampled vs the expected 80*4/16 = 20
+    led = _ledger(n, count=count, ema_loss=1.0)
+    s = CohortSampler(n, k, seed=0, mode="adaptive", staleness_gain=4.0)
+    s.observe_snapshot(led, 80)
+    assert s.probs[5] > 2.0 * np.delete(s.probs, 5).mean(), s.probs
+
+
+def test_adaptive_unseen_clients_get_optimistic_utility():
+    led = _ledger(8, count=[5, 5, 5, 5, 0, 0, 0, 0],
+                  ema_loss=[0.1, 0.2, 0.1, 0.2, 0, 0, 0, 0])
+    s = CohortSampler(8, 2, seed=0, mode="adaptive")
+    s.observe_snapshot(led, 10)
+    # unseen clients take the MAX seen utility plus the full staleness
+    # boost — they must be at least as likely as any seen client
+    assert s.probs[4:].min() >= s.probs[:4].max() - 1e-12, s.probs
+
+
+def test_observe_snapshot_rejected_for_fixed_mode():
+    s = CohortSampler(8, 2, seed=0)
+    with pytest.raises(ValueError, match="adaptive"):
+        s.observe_snapshot(_ledger(8), 1)
+
+
+def test_adaptive_config_pairing_rejections():
+    def base():
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.server.sampling = "adaptive"
+        cfg.run.obs.client_ledger.enabled = True
+        cfg.run.obs.client_ledger.log_every = 2
+        return cfg
+
+    base().validate()  # the sound baseline
+    cfg = base()
+    cfg.run.obs.client_ledger.enabled = False
+    with pytest.raises(ValueError, match="client_ledger"):
+        cfg.validate()
+    cfg = base()
+    cfg.run.obs.client_ledger.log_every = 0
+    with pytest.raises(ValueError, match="log_every"):
+        cfg.validate()
+    cfg = base()
+    cfg.run.fuse_rounds = 4  # log_every=2 not a multiple
+    cfg.server.num_rounds = 8
+    with pytest.raises(ValueError, match="chunk"):
+        cfg.validate()
+    cfg = base()
+    cfg.data.placement = "stream"
+    with pytest.raises(ValueError, match="stream"):
+        cfg.validate()
+    cfg = base()
+    cfg.run.shape_buckets.enabled = True
+    with pytest.raises(ValueError, match="shape_buckets"):
+        cfg.validate()
+    cfg = base()
+    cfg.run.host_pipeline = "native"
+    with pytest.raises(ValueError, match="native"):
+        cfg.validate()
+    cfg = base()
+    cfg.server.adaptive.explore = 0.0
+    with pytest.raises(ValueError, match="explore"):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# determinism across checkpoint resume (weighted + adaptive) — the
+# resumed schedule must equal the straight-run schedule, including
+# through a ledger-snapshot boundary
+# ---------------------------------------------------------------------------
+
+
+def _determinism_cfg(out, rounds, sampling, resume=False):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": rounds, "server.eval_every": 0,
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 32, "client.batch_size": 16,
+        "run.out_dir": str(out), "run.metrics_flush_every": 2,
+        "server.sampling": sampling,
+        "server.checkpoint_every": 3,
+        "run.resume": resume,
+    })
+    if sampling == "adaptive":
+        cfg.apply_overrides({
+            "run.obs.client_ledger.enabled": True,
+            "run.obs.client_ledger.log_every": 2,
+        })
+    return cfg.validate()
+
+
+def _fit_with_cohorts(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    cohorts = {}
+    orig = exp.sampler.sample
+
+    def wrap(r):
+        c = orig(r)
+        cohorts[r] = tuple(int(x) for x in c)
+        return c
+
+    exp.sampler.sample = wrap
+    state = exp.fit()
+    return exp, state, cohorts
+
+
+@pytest.mark.parametrize("sampling", ["weighted", "adaptive"])
+def test_sampler_schedule_deterministic_across_resume(tmp_path, sampling):
+    """Resume at round 3 (checkpoint_every=3) and run to 6: the resumed
+    schedule must equal the straight run's for every round — for
+    adaptive that crosses the ledger-snapshot boundary at round 4
+    (log_every=2), exercising both the checkpointed snapshot (rounds
+    3..3) and a post-resume refresh (rounds 4..5)."""
+    import jax
+    import numpy as np
+
+    _, s6, straight = _fit_with_cohorts(
+        _determinism_cfg(tmp_path / "straight", 6, sampling))
+    _fit_with_cohorts(_determinism_cfg(tmp_path / "resumed", 3, sampling))
+    _, r6, resumed = _fit_with_cohorts(
+        _determinism_cfg(tmp_path / "resumed", 6, sampling, resume=True))
+    for r in range(3, 6):
+        assert straight[r] == resumed[r], (r, straight[r], resumed[r])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        s6["params"], r6["params"],
+    )
+    if sampling == "adaptive":
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(s6["ledger"])),
+            np.asarray(jax.device_get(r6["ledger"])),
+        )
+
+
 def test_config_wires_weighted_sampling():
     cfg = get_named_config("cifar10_fedavg_100")
     cfg.server.sampling = "weighted"
