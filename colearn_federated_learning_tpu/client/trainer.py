@@ -53,14 +53,22 @@ def make_client_optimizer(cfg: ClientConfig) -> optax.GradientTransformation:
     return opt
 
 
-def normalize_input(x):
+def normalize_input(x, dtype=jnp.float32):
     """uint8 image corpora are stored RAW (4× the HBM capacity and 4× the
     host→device bandwidth of f32 — data/core.py); the [0,1] scaling
     happens here on device, where XLA fuses it into the first conv's
     input handling. Float inputs pass through untouched, int token ids
-    (LM task) are never uint8."""
+    (LM task) are never uint8.
+
+    ``dtype``: the scaled batch's dtype. The TRAIN step passes the
+    model's compute dtype (bf16 on the TPU configs — the bf16-compute
+    policy end-to-end: without this the scaled batch materializes in
+    f32 only for the model's first op to convert it back down). uint8
+    values 0..255 are exact in bf16 (8-bit mantissa); the only rounding
+    vs the f32 path is the 1/255 scale, identical per element. Eval and
+    model init keep the f32 default (metrics stay full precision)."""
     if x.dtype == jnp.uint8:
-        return x.astype(jnp.float32) * (1.0 / 255.0)
+        return x.astype(dtype) * jnp.asarray(1.0 / 255.0, dtype)
     return x
 
 
@@ -70,10 +78,19 @@ def make_loss_fn(model, task: str, reduction: str = "mean"):
     ``reduction="sum"`` returns the plain mask-weighted sum — what the
     batch-sharded path needs, where the mean's denominator spans all
     batch shards and is applied after the cross-shard psum.
+
+    Inputs are normalized straight into the model's COMPUTE dtype (see
+    :func:`normalize_input`): with bf16 compute the whole train step —
+    input scaling, every matmul/conv, activations, and the backward —
+    runs bf16 end-to-end; the loss itself stays f32 (the cross-entropy
+    head's logits are f32 by model design).
     """
+    in_dtype = getattr(model, "compute_dtype", jnp.float32)
 
     def loss_fn(params, x, y, m):
-        logits = model.apply({"params": params}, normalize_input(x), train=True)
+        logits = model.apply(
+            {"params": params}, normalize_input(x, in_dtype), train=True
+        )
         if task == "classify":
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         else:  # lm: mean over tokens within each example

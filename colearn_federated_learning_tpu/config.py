@@ -368,6 +368,22 @@ class ServerConfig:
     # uplink `compression` knob for the full comm-constrained story.
     downlink_compression: str = ""  # "" | qsgd
     downlink_qsgd_levels: int = 256
+    # Fused server-apply chain (ops/pallas_apply.py): run the round
+    # tail — trust/weight scaling → weighted reduction (stacked paths)
+    # → server delta apply → optimizer update — as ONE VMEM-resident
+    # pallas kernel pass over the flat param vector instead of a chain
+    # of full-params XLA ops (each a |params| HBM round trip; the
+    # stacked robust/attack paths additionally materialize weighted
+    # [K, |params|] intermediates the kernel never writes). On the
+    # weighted_mean psum path the in-lane reduction is untouched and
+    # the kernel fuses apply+optimizer only; median/trimmed_mean keep
+    # their coordinate-wise sorts (not a weighted reduction) and also
+    # take the apply-only fusion. Interpret mode (exact, slow) runs the
+    # same kernel on non-TPU backends, so CPU CI pins it against the
+    # unfused reference per aggregator × reputation × error feedback.
+    # Requires optimizer "mean" or "fedavgm" (the kernel's FMA chain);
+    # fused ≡ unfused at f32-reassociation tolerance, not bitwise.
+    fused_apply: bool = False
     # Reputation-weighted aggregation off the client ledger — see
     # ReputationConfig.
     reputation: ReputationConfig = field(default_factory=ReputationConfig)
@@ -633,6 +649,20 @@ class RunConfig:
     # missing-#4). 0 = auto (device memory_stats when the backend
     # reports one, else 16 GiB on TPU, else skip on CPU); -1 = disable.
     hbm_gb: float = 0.0
+    # Double-buffered host↔device rounds (server/round_driver.py): a
+    # host worker thread builds round N+1's inputs AND places them on
+    # device (a second in-flight placed-slab buffer keyed like the
+    # prefetch futures) while the device executes round N's dispatched
+    # compute, so the round.host_inputs / round.placement phases hide
+    # under round.dispatch. Inputs are pure in (seed, round[, ledger
+    # snapshot]), so buffered ≡ unbuffered BITWISE (test-pinned); the
+    # overlap drains itself wherever purity would break — fused-chunk
+    # grids built for a different ladder rung are dropped and rebuilt,
+    # and the adaptive sampler never prefetches across a ledger-
+    # snapshot refresh boundary. stream placement keeps its legacy
+    # build-only one-ahead prefetch (a placed slab would double the
+    # bounded-memory promise); fedbuff's scheduler is not buffered.
+    double_buffer: bool = True
     # Host-side round-input construction (idx/mask/n_ex tensors):
     #   auto   — the C++ threaded pipeline (native/) when the toolchain
     #            builds it, else the NumPy path; prefetches round r+1
@@ -1326,13 +1356,42 @@ class ExperimentConfig:
             )
         if self.data.placement not in ("hbm", "stream"):
             raise ValueError(f"unknown data.placement {self.data.placement!r}")
+        # dtype strings are resolved through a fixed table deep in the
+        # driver — without this check a typo ("bf16") surfaces as an
+        # opaque KeyError/jnp.dtype error far from the config
+        _DTYPE_NAMES = ("float32", "bfloat16", "float16")
         for f in ("param_dtype", "compute_dtype"):
-            if getattr(self.run, f) not in ("float32", "bfloat16", "float16"):
-                raise ValueError(f"unknown run.{f} {getattr(self.run, f)!r}")
-        if self.run.local_param_dtype not in ("", "float32", "bfloat16", "float16"):
+            if getattr(self.run, f) not in _DTYPE_NAMES:
+                raise ValueError(
+                    f"unknown run.{f} {getattr(self.run, f)!r}; "
+                    f"allowed: {', '.join(_DTYPE_NAMES)}"
+                )
+        if self.run.local_param_dtype not in ("",) + _DTYPE_NAMES:
             raise ValueError(
-                f"unknown run.local_param_dtype {self.run.local_param_dtype!r}"
+                f"unknown run.local_param_dtype "
+                f"{self.run.local_param_dtype!r}; allowed: '' (inherit "
+                f"run.param_dtype), {', '.join(_DTYPE_NAMES)}"
             )
+        if self.server.fused_apply:
+            if self.server.optimizer not in ("mean", "fedavgm"):
+                # the kernel's one-pass FMA chain is exactly
+                # sgd(+momentum); fedadam/fedyogi second-moment state
+                # has no single-pass expression
+                raise ValueError(
+                    "server.fused_apply supports server.optimizer="
+                    "'mean' or 'fedavgm' only (the pallas kernel "
+                    "implements the sgd(+momentum) update); got "
+                    f"{self.server.optimizer!r}"
+                )
+            if self.algorithm in ("scaffold", "feddyn", "gossip"):
+                # scaffold/feddyn interleave their c/h state recursions
+                # with the apply (feddyn bypasses the server optimizer
+                # entirely); gossip has no server apply at all
+                raise ValueError(
+                    f"server.fused_apply is incompatible with "
+                    f"algorithm={self.algorithm!r} (stateful algorithms "
+                    f"own the server step; gossip has no server apply)"
+                )
         obs = self.run.obs
         if obs.on_unhealthy not in ("warn", "abort", "checkpoint_abort"):
             raise ValueError(

@@ -69,11 +69,22 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     rounds = 0
     rps: List[float] = []
     last_eval: Dict[str, float] = {}
+    precision: Dict[str, Any] = {}
     dropped = stragglers = byzantine = 0
     for rec in records:
         ev = rec.get("event")
         if ev:
             events[ev] = events.get(ev, 0) + 1
+        if ev == "precision":
+            # dtype/fusion provenance logged at fit start — surfaced so
+            # a throughput read-off carries its compute_dtype column
+            precision = {
+                k: rec.get(k) for k in (
+                    "param_dtype", "compute_dtype", "local_param_dtype",
+                    "fused_apply", "double_buffer",
+                ) if k in rec
+            }
+            continue
         if ev == "spans":
             for name, agg in (rec.get("phases") or {}).items():
                 cur = phases.setdefault(
@@ -119,6 +130,8 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["health"] = health
     if last_eval:
         out["final_eval"] = last_eval
+    if precision:
+        out["precision"] = precision
     return out
 
 
@@ -138,6 +151,18 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
     if "rounds_per_sec_mean" in summary:
         head += f"  rounds/sec (window mean): {summary['rounds_per_sec_mean']:.3f}"
     lines.append(head)
+    prec = summary.get("precision")
+    if prec:
+        bits = [
+            f"compute={prec.get('compute_dtype', '?')}",
+            f"params={prec.get('param_dtype', '?')}",
+            f"local={prec.get('local_param_dtype', '?')}",
+        ]
+        if prec.get("fused_apply"):
+            bits.append("fused_apply")
+        if prec.get("double_buffer"):
+            bits.append("double_buffer")
+        lines.append("precision: " + "  ".join(bits))
     phases = summary.get("phases") or {}
     if phases:
         # share is relative to the "round" parent span when present,

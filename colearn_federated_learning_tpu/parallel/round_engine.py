@@ -125,11 +125,51 @@ def _scaffold_c_update(b_c, c_global, params, w_b, k_valid, lr_i, part):
     return jax.tree.map(leaf, b_c, c_global, params, w_b)
 
 
+def _fused_stack_inputs(stacked, n_ex, trust, aggregator: str, agg: str,
+                        byzantine_f: int, cohort_size: int):
+    """(stack, combined ``[K]`` weights) feeding the fused reduce-apply
+    kernel (``server.fused_apply``, ops/pallas_apply.py) — ONE shared
+    implementation for the sharded program and the sequential oracle,
+    so the fused path's cross-engine parity holds by construction:
+
+    - ``weighted_mean``: the FedAvg weight (examples or participation)
+      × reputation trust, divided by the guarded weight sum — exactly
+      ``stack_weighted_mean``'s arithmetic, pre-folded so the kernel's
+      contraction is the finished mean.
+    - ``krum``: trust scales the stack first (the same soft suppression
+      as the unfused path), then the winner's one-hot row IS the
+      reduction — selection as a degenerate weighted sum. ``m == 0``
+      (full dropout) zeroes the row, preserving robust_reduce's
+      zero-update semantics.
+    """
+    if aggregator == "krum":
+        from colearn_federated_learning_tpu.server.aggregation import (
+            krum_select,
+            scale_deltas_by_trust,
+        )
+
+        if trust is not None:
+            stacked = scale_deltas_by_trust(stacked, trust)
+        winner, m = krum_select(stacked, n_ex > 0, byzantine_f)
+        w = jax.nn.one_hot(winner, cohort_size, dtype=jnp.float32)
+        return stacked, w * (m > 0)
+    w = (
+        n_ex.astype(jnp.float32) if agg == "examples"
+        else (n_ex > 0).astype(jnp.float32)
+    )
+    if trust is not None:
+        w = w * trust.astype(jnp.float32)
+    w_sum = w.sum()
+    denom = jnp.where(w_sum > 0, w_sum, 1.0)
+    return stacked, w / denom
+
+
 def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=False, feddyn=False, client_dp=0.0,
                          downlink="", secagg_quant_step=0.0,
                          error_feedback=False, attack="",
-                         client_ledger=False, reputation=False):
+                         client_ledger=False, reputation=False,
+                         fused_apply=False):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -280,6 +320,15 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                 "client_ledger is not supported with stateful "
                 "algorithms (they own the per-client state path)"
             )
+    if fused_apply and (scaffold or feddyn):
+        # mirror config.validate(): the stateful algorithms interleave
+        # their c/h recursions with the apply (feddyn bypasses the
+        # server optimizer entirely) — there is no plain delta-apply
+        # chain for the kernel to replace
+        raise ValueError(
+            "fused_apply is incompatible with stateful algorithms "
+            "(they own the server step)"
+        )
     if reputation and not client_ledger:
         # mirror config.validate(): the trust weights are a pure
         # function of the ledger rows — without the ledger there is no
@@ -565,7 +614,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           reputation: bool = False,
                           rep_floor: float = 0.05,
                           rep_strength: float = 6.0,
-                          rep_z_gain: float = 1.0):
+                          rep_z_gain: float = 1.0,
+                          fused_apply: bool = False):
     """Build the jitted one-program round function.
 
     Signature of the returned fn::
@@ -713,6 +763,19 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     from the carried ledger per sub-round) and with the attack stack —
     that composition is the point: soft degradation where krum's hard
     rejection breaks near f ≈ K/2.
+
+    ``fused_apply`` (``server.fused_apply``, ops/pallas_apply.py):
+    requires a ``server_update`` built by ``make_server_update_fn``
+    with the same flag (which already fuses the psum path's delta
+    apply + optimizer into one pallas pass). Here it additionally
+    routes the STACKED paths — attacked weighted_mean and krum — into
+    ``server_update.fused_reduce``: trust/weight scaling, the weighted
+    reduction (krum's winner as a one-hot row via
+    ``_fused_stack_inputs``), the delta apply, and the optimizer run
+    as one VMEM-resident kernel pass, with the delta emitted for the
+    client ledger's cosine stat. median/trimmed_mean keep their
+    per-coordinate sorts and take the apply-only fusion. Fused ≡
+    unfused at f32-reassociation tolerance (tests/test_fused_apply.py).
     """
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          secagg=secagg, feddyn=feddyn_alpha > 0.0,
@@ -720,7 +783,15 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                          secagg_quant_step=secagg_quant_step,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
-                         reputation=reputation)
+                         reputation=reputation, fused_apply=fused_apply)
+    if fused_apply and not hasattr(server_update, "fused_reduce"):
+        # the stacked-path kernel entry lives on the fused server
+        # update (make_server_update_fn with cfg.fused_apply) — a
+        # mismatched pairing would silently run the unfused tail
+        raise ValueError(
+            "fused_apply=True requires a server_update built by "
+            "make_server_update_fn with fused_apply enabled"
+        )
     if client_dp_noise > 0.0 and agg != "uniform":
         # the fixed-denominator sensitivity analysis needs w_i ∈ {0,1}
         raise ValueError(
@@ -1511,16 +1582,42 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         wire = None
         if emit_stack or client_ledger:
             wire = _wire_stack(out, n_ex, byz, keys)
-        with jax.named_scope("round_aggregate"):
-            delta = _mean_delta(out, n_ex, params, wire, trust)
+        if fused_apply and emit_stack and aggregator in (
+            "weighted_mean", "krum",
+        ):
+            # the fused server chain (server.fused_apply): trust/weight
+            # scaling → weighted reduction → delta apply → optimizer as
+            # ONE pallas pass over the flat param vector. The stack is
+            # pinned replicated first: the kernel is an opaque custom
+            # call GSPMD cannot partition, and the robust/attacked
+            # paths materialize the full stack for their cross-lane
+            # statistics anyway.
+            with jax.named_scope("round_fused_reduce_apply"):
+                stack_in, w_in = _fused_stack_inputs(
+                    wire, n_ex, trust, aggregator, agg, byzantine_f,
+                    cohort_size,
+                )
+                from jax.sharding import NamedSharding
+
+                rep = NamedSharding(mesh, P())
+                stack_in = jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(a, rep),
+                    stack_in,
+                )
+                new_params, new_opt_state, delta = server_update.fused_reduce(
+                    params, server_opt_state, stack_in, w_in
+                )
+        else:
+            with jax.named_scope("round_aggregate"):
+                delta = _mean_delta(out, n_ex, params, wire, trust)
+            with jax.named_scope("round_server_apply"):
+                new_params, new_opt_state = server_update(
+                    params, server_opt_state, delta
+                )
         new_ledger = None
         if client_ledger:
             new_ledger = _ledger_update(out, wire, delta, n_ex, ledger,
                                         cohort)
-        with jax.named_scope("round_server_apply"):
-            new_params, new_opt_state = server_update(
-                params, server_opt_state, delta
-            )
         metrics = RoundMetrics(out["loss"], out["n"])
         if client_ledger:
             return new_params, new_opt_state, new_ledger, metrics
@@ -1782,7 +1879,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              reputation: bool = False,
                              rep_floor: float = 0.05,
                              rep_strength: float = 6.0,
-                             rep_z_gain: float = 1.0):
+                             rep_z_gain: float = 1.0,
+                             fused_apply: bool = False):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -1807,7 +1905,12 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                          secagg_quant_step=secagg_quant_step,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
-                         reputation=reputation)
+                         reputation=reputation, fused_apply=fused_apply)
+    if fused_apply and not hasattr(server_update, "fused_reduce"):
+        raise ValueError(
+            "fused_apply=True requires a server_update built by "
+            "make_server_update_fn with fused_apply enabled"
+        )
     if client_dp_noise > 0.0 and agg != "uniform":
         raise ValueError(
             "client-level DP requires uniform aggregation weights "
@@ -1834,6 +1937,11 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                                               local_dtype=local_dtype,
                                               scan_unroll=scan_unroll))
     update = jax.jit(server_update)
+    # the fused stacked-path entry, jitted ONCE at the factory (the
+    # interpret-mode kernel would otherwise re-trace eagerly per round)
+    fused_reduce = (
+        jax.jit(server_update.fused_reduce) if fused_apply else None
+    )
 
     use_decay = client_cfg.lr_decay != 1.0
     # ONE jit wrapper per factory: eager per-client pairwise uploads
@@ -2041,6 +2149,7 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
             jnp.float32(dp_fixed_denom or k)
             if client_dp_noise > 0.0 else denom
         )
+        fused_out = None
         if robust or attack:
             # the per-client stack path — identical ops to the sharded
             # engine's _mean_delta (shared transform + shared stack
@@ -2056,7 +2165,22 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                     stacked, jnp.asarray(byz), keys, attack, attack_scale,
                     attack_eps, participation=jnp.asarray(n_ex) > 0,
                 )
-            if robust:
+            if fused_reduce is not None and aggregator in (
+                "weighted_mean", "krum",
+            ):
+                # fused server chain: the SAME shared weight/one-hot
+                # construction as the sharded program feeds the same
+                # kernel — fused-path cross-engine parity by
+                # construction (ops/pallas_apply.py)
+                stack_in, w_in = _fused_stack_inputs(
+                    stacked, jnp.asarray(n_ex), trust, aggregator, agg,
+                    byzantine_f, k,
+                )
+                fused_out = fused_reduce(
+                    params, server_opt_state, stack_in, w_in
+                )
+                mean_delta = fused_out[2]
+            elif robust:
                 from colearn_federated_learning_tpu.server.aggregation import (
                     robust_reduce,
                     scale_deltas_by_trust,
@@ -2151,7 +2275,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                 )
             return (new_params, new_opt_state, new_c_global, new_c_cohort,
                     RoundMetrics(mean_loss, n_total))
-        new_params, new_opt_state = update(params, server_opt_state, mean_delta)
+        if fused_out is not None:
+            # params/opt state already advanced inside the fused kernel
+            # pass (mean_delta above was its third output)
+            new_params, new_opt_state = fused_out[0], fused_out[1]
+        else:
+            new_params, new_opt_state = update(
+                params, server_opt_state, mean_delta
+            )
         if error_feedback:
             new_e_cohort = jax.tree.map(lambda *ls: jnp.stack(ls), *new_cs)
             if client_ledger:

@@ -136,8 +136,14 @@ def robust_reduce(deltas, participation, mode: str, trim_ratio: float = 0.1,
     return jax.tree.map(leaf, deltas)
 
 
-def _krum(deltas, participation, byzantine_f: int):
-    """Krum selection over a [K, ...] delta stack (see robust_reduce)."""
+def krum_select(deltas, participation, byzantine_f: int):
+    """The selection half of krum: ``(winner index, participant count)``
+    over a [K, ...] delta stack. Split out of :func:`_krum` so the
+    fused server-apply path (``server.fused_apply``) can turn the
+    winner into a one-hot reduction row for the pallas kernel while
+    ``_krum`` itself keeps the identical score/argmin ops (bitwise-
+    preserving refactor — the unfused path's float sequence is
+    unchanged)."""
     part = participation.astype(jnp.float32)
     k = part.shape[0]
     m = part.sum()
@@ -160,7 +166,12 @@ def _krum(deltas, participation, byzantine_f: int):
     # m == 1: the lone participant has no neighbours (score inf) — give
     # it score 0 so argmin still selects a participant
     scores = jnp.where(alive & (m > 1), scores, jnp.where(alive, 0.0, inf))
-    winner = jnp.argmin(scores)
+    return jnp.argmin(scores), m
+
+
+def _krum(deltas, participation, byzantine_f: int):
+    """Krum selection over a [K, ...] delta stack (see robust_reduce)."""
+    winner, m = krum_select(deltas, participation, byzantine_f)
     # m == 0 (full dropout): every score is inf and argmin would pick an
     # arbitrary NON-participant — return the zero update instead, like
     # the median/trimmed_mean paths do
@@ -201,16 +212,81 @@ def make_server_update_fn(cfg: ServerConfig):
     optax state) are not restorable against the current template. No
     migration shim is shipped: there are no deployed checkpoints of the
     old format (run artifacts were never part of the repo).
+
+    ``cfg.fused_apply`` swaps the optax chain for the pallas fused
+    server-apply kernel (ops/pallas_apply.py): the delta apply and the
+    optimizer update run as one VMEM-resident pass over the flat param
+    vector instead of a chain of full-params XLA ops. The optax STATE
+    STRUCTURE is kept bit-for-bit (``(TraceState, EmptyState)`` /
+    ``(EmptyState, EmptyState)``), so fused and unfused runs checkpoint-
+    interoperate; only ``mean`` / ``fedavgm`` are expressible as the
+    kernel's single FMA chain (validate() enforces it; this factory
+    guards direct callers). The returned ``update`` additionally carries
+    a ``fused_reduce(params, opt_state, wire_stack, weights)`` attribute
+    — the stacked-path entry the engines use to fuse trust/weight
+    scaling → weighted reduction → apply → optimizer into the same
+    kernel (weights pre-folded with the 1/denominator or krum's one-hot
+    winner row). Fused ≡ unfused at f32-reassociation tolerance
+    (tests/test_fused_apply.py), never bitwise — the fused FMA order
+    differs.
     """
     opt = make_server_optimizer(cfg)
+    fused = getattr(cfg, "fused_apply", False)
+    if fused and cfg.optimizer not in ("mean", "fedavgm"):
+        # mirror of config.validate() for direct callers: fedadam/
+        # fedyogi carry second-moment state the one-pass kernel does
+        # not model
+        raise ValueError(
+            "server.fused_apply supports optimizer='mean' or 'fedavgm' "
+            f"only, got {cfg.optimizer!r}"
+        )
 
     def init(params) -> Any:
         return {"round": jnp.zeros((), jnp.int32), "opt": opt.init(params)}
 
-    def update(params, opt_state, mean_delta) -> Tuple[Any, Any]:
-        pseudo_grad = jax.tree.map(jnp.negative, mean_delta)
-        updates, new_opt = opt.update(pseudo_grad, opt_state["opt"], params)
-        new_state = {"round": opt_state["round"] + 1, "opt": new_opt}
-        return optax.apply_updates(params, updates), new_state
+    if not fused:
+        def update(params, opt_state, mean_delta) -> Tuple[Any, Any]:
+            pseudo_grad = jax.tree.map(jnp.negative, mean_delta)
+            updates, new_opt = opt.update(pseudo_grad, opt_state["opt"], params)
+            new_state = {"round": opt_state["round"] + 1, "opt": new_opt}
+            return optax.apply_updates(params, updates), new_state
 
+        return init, update
+
+    from colearn_federated_learning_tpu.ops.pallas_apply import (
+        fused_delta_apply,
+        fused_reduce_apply,
+    )
+
+    has_mom = cfg.optimizer == "fedavgm"
+    beta = cfg.server_momentum if has_mom else 0.0
+
+    def _momentum(opt_state):
+        # optax.sgd state: (TraceState(trace), EmptyState()) with
+        # momentum, (EmptyState(), EmptyState()) without
+        return opt_state["opt"][0].trace if has_mom else None
+
+    def _repack(opt_state, new_mom) -> Any:
+        new_opt = opt_state["opt"]
+        if has_mom:
+            new_opt = (new_opt[0]._replace(trace=new_mom),) + new_opt[1:]
+        return {"round": opt_state["round"] + 1, "opt": new_opt}
+
+    def update(params, opt_state, mean_delta) -> Tuple[Any, Any]:
+        new_params, new_mom = fused_delta_apply(
+            params, _momentum(opt_state), mean_delta,
+            cfg.server_lr, beta,
+        )
+        return new_params, _repack(opt_state, new_mom)
+
+    def fused_reduce(params, opt_state, wire_stack, weights):
+        """(params′, opt_state′, mean_delta) from the wire stack in one
+        kernel pass; ``weights`` pre-folded (see ops/pallas_apply)."""
+        new_params, new_mom, mean_delta = fused_reduce_apply(
+            wire_stack, weights, params, _momentum(opt_state),
+            cfg.server_lr, beta,
+        )
+        return new_params, _repack(opt_state, new_mom), mean_delta
+
+    update.fused_reduce = fused_reduce
     return init, update

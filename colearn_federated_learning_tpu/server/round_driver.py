@@ -63,6 +63,34 @@ from colearn_federated_learning_tpu.utils.metrics import MetricsLogger
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
+# warn-once latch for bf16-on-a-backend-without-native-bf16-matmuls:
+# the run is CORRECT there (XLA emulates), just silently slow — e.g. a
+# TPU config's bf16 settings smoke-tested on a CPU box
+_BF16_BACKEND_WARNED = False
+
+
+def _warn_bf16_backend(cfg) -> None:
+    global _BF16_BACKEND_WARNED
+    if _BF16_BACKEND_WARNED:
+        return
+    eff_local = cfg.run.local_param_dtype or cfg.run.param_dtype
+    if "bfloat16" not in (cfg.run.compute_dtype, eff_local):
+        return
+    backend = jax.default_backend()
+    if backend in ("tpu", "gpu"):
+        return
+    _BF16_BACKEND_WARNED = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "bfloat16 compute requested (run.compute_dtype=%s, effective "
+        "local dtype %s) on backend %r, which has no native bf16 "
+        "matmul units — results are correct but matmuls run emulated "
+        "and SLOWER than float32; this is expected only when "
+        "smoke-testing a TPU config off-TPU",
+        cfg.run.compute_dtype, eff_local, backend,
+    )
+
 
 class Experiment:
     """Everything needed to run ``fit`` / ``evaluate`` for one config."""
@@ -382,6 +410,7 @@ class Experiment:
                         rep_floor=cfg.server.reputation.floor,
                         rep_strength=cfg.server.reputation.strength,
                         rep_z_gain=cfg.server.reputation.z_gain,
+                        fused_apply=cfg.server.fused_apply,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
@@ -434,6 +463,7 @@ class Experiment:
                 rep_floor=cfg.server.reputation.floor,
                 rep_strength=cfg.server.reputation.strength,
                 rep_z_gain=cfg.server.reputation.z_gain,
+                fused_apply=cfg.server.fused_apply,
             )
             self._data_sharding = None
             self._cohort_sharding = None
@@ -476,6 +506,18 @@ class Experiment:
             self._fused_client_sharding = None
         self._prefetch: Dict[int, Any] = {}
         self._host_executor = None
+        # Double-buffered rounds (run.double_buffer, ROADMAP item 2
+        # lever c): a host worker builds AND places round N+1's inputs
+        # while round N's dispatched compute runs — see _maybe_prefetch
+        # for the drain rules (fuse chunks, bucket rungs, adaptive
+        # snapshot boundaries). fedbuff's scheduler pops its queue
+        # in-order and is not buffered.
+        self._double_buffer = bool(cfg.run.double_buffer) and not self.fedbuff
+        self._db_stats = {
+            "host_prefetched": 0, "placed_prefetched": 0,
+            "prefetch_dropped": 0,
+        }
+        _warn_bf16_backend(cfg)
         if self._stream:
             self._slab_rows = min(
                 cfg.server.cohort_size * self.shape.cap + 1,
@@ -1174,6 +1216,108 @@ class Experiment:
             }
         return mask, n_ex
 
+    def _prefetch_spe(self, round_idx: int) -> Optional[int]:
+        """The ladder rung the steady-state dispatch will request for
+        this round (None without buckets): the chunk-max rung under
+        fusion, the round's own rung otherwise. Pure in (seed, round),
+        so the prefetch worker and the consumer agree — an unaligned-
+        resume catch-up round (dispatched fuse=1 on its OWN rung) is
+        the one deliberate mismatch, and the consumer drains it."""
+        if self._bucket_ladder is None:
+            return None
+        fuse = self.cfg.run.fuse_rounds
+        if fuse > 1:
+            start = round_idx - round_idx % fuse
+            end = min(start + fuse, self.cfg.server.num_rounds)
+            return max(self._round_bucket_spe(j) for j in range(start, end))
+        return self._round_bucket_spe(round_idx)
+
+    def _place_round_inputs(self, idx, mask, n_ex, slab):
+        """Device placement of one round's host tensors — shared by the
+        critical path and the double-buffer prefetch worker (device_put
+        is async, so a worker-thread placement overlaps the dispatched
+        compute of the PREVIOUS round)."""
+        if slab is not None:
+            idx, slab_x, slab_y = slab
+            train_x = self._put_data(jnp.asarray(slab_x))
+            train_y = self._put_data(jnp.asarray(slab_y))
+        else:
+            train_x, train_y = self.train_x, self.train_y
+        if self._cohort_sharding is not None:
+            idx = self._put(idx, self._cohort_sharding)
+            # the [K, 2] spec has no batch dim — cohort-sharded only
+            mask = self._put(
+                mask,
+                self._client_sharding if self._spec_inputs
+                else self._cohort_sharding,
+            )
+            n_ex = self._put(n_ex, self._client_sharding)
+        return idx, mask, n_ex, train_x, train_y
+
+    def _build_prefetch_entry(self, round_idx: int, spe: Optional[int],
+                              place: bool) -> Dict[str, Any]:
+        """Worker-thread body: build (and, double-buffered, place) one
+        round's inputs. The entry records the rung it was built for so
+        the consumer can detect (and drain) a grid mismatch."""
+        shape = self._bucket_shape(spe) if spe is not None else None
+        cohort, idx, mask, n_ex, slab = self._host_inputs(
+            round_idx, shape=shape
+        )
+        placed = (
+            self._place_round_inputs(idx, mask, n_ex, slab) if place
+            else None
+        )
+        return {"spe": spe, "host": (cohort, idx, mask, n_ex, slab),
+                "placed": placed}
+
+    def _ensure_executor(self):
+        if self._host_executor is None and (
+            self._double_buffer or self._stream
+        ):
+            from concurrent.futures import ThreadPoolExecutor
+
+            # ONE worker: all builds serialize, so the native pipeline
+            # and the samplers never see two concurrent builders
+            self._host_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="colearn-prefetch"
+            )
+        return self._host_executor
+
+    def _maybe_prefetch(self, round_idx: int) -> None:
+        """Submit the upcoming rounds' input builds to the host worker
+        (run.double_buffer): the next round's build AND placement run
+        while round_idx's dispatched compute executes — the second
+        in-flight placed-slab buffer. Under fuse_rounds the whole next
+        chunk's host slabs build ahead (placement stays with the chunk
+        stacker); stream mode keeps its legacy build-only single
+        look-ahead (a placed slab would double the bounded-memory
+        promise). The adaptive sampler never prefetches across a
+        ledger-snapshot refresh boundary — the cohort there is a
+        function of a snapshot that does not exist yet."""
+        ex = self._ensure_executor()
+        if ex is None:
+            return
+        fuse = self.cfg.run.fuse_rounds
+        if not self._double_buffer:
+            depth = 1  # legacy stream-mode behavior
+        elif fuse > 1:
+            depth = fuse
+        else:
+            depth = 2
+        for t in range(round_idx + 1, round_idx + 1 + depth):
+            if t >= self.cfg.server.num_rounds or t in self._prefetch:
+                continue
+            if self._adaptive:
+                le = self._ledger_cfg.log_every
+                if t // le != round_idx // le:
+                    continue
+            place = (
+                self._double_buffer and not self._stream and fuse == 1
+            )
+            self._prefetch[t] = ex.submit(
+                self._build_prefetch_entry, t, self._prefetch_spe(t), place
+            )
+
     def _round_inputs(self, round_idx: int, place: bool = True,
                       shape: Optional[RoundShape] = None):
         """``place=False`` returns the idx/mask/n_ex tensors as HOST
@@ -1184,27 +1328,35 @@ class Experiment:
         fused chunk-max grid override; prefetch entries are keyed by
         round with the bucket baked in (the bucket is a pure function
         of the round, so worker and consumer agree)."""
+        if shape is not None:
+            want_spe = shape.steps_per_epoch
+        elif self._bucket_ladder is not None:
+            want_spe = self._round_bucket_spe(round_idx)
+        else:
+            want_spe = None
         fut = self._prefetch.pop(round_idx, None)
+        entry = None
         # the span measures the CRITICAL-PATH host-input cost: ~0 when
         # the prefetch worker ran ahead, the full build otherwise
         with self.tracer.span("round.host_inputs"):
             if fut is not None:
-                cohort, idx, mask, n_ex, slab = fut.result()
+                entry = fut.result()
+                if entry["spe"] != want_spe:
+                    # overlap drain: the prefetched grid was built for a
+                    # different ladder rung (unaligned-resume catch-up
+                    # dispatches on the round's own rung, not the
+                    # steady-state chunk max) — rebuild on the right one
+                    self._db_stats["prefetch_dropped"] += 1
+                    entry = None
+                else:
+                    self._db_stats["host_prefetched"] += 1
+            if entry is not None:
+                cohort, idx, mask, n_ex, slab = entry["host"]
             else:
                 cohort, idx, mask, n_ex, slab = self._host_inputs(
                     round_idx, shape=shape
                 )
-        if self._stream and self._host_executor is None:
-            # slab gathering is the heavy host work in stream mode; build
-            # round r+1's slab on a worker thread while the device runs r
-            # (created lazily; fit() shuts it down when the loop ends)
-            from concurrent.futures import ThreadPoolExecutor
-
-            self._host_executor = ThreadPoolExecutor(max_workers=1)
-        nxt = round_idx + 1
-        if (self._host_executor is not None and nxt < self.cfg.server.num_rounds
-                and nxt not in self._prefetch):
-            self._prefetch[nxt] = self._host_executor.submit(self._host_inputs, nxt)
+        self._maybe_prefetch(round_idx)
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._counters_on:
             stats = self._round_comm(cohort, n_host)
@@ -1228,21 +1380,16 @@ class Experiment:
             # fuse>1 requires hbm placement (validate), so slab is None
             return cohort, idx, mask, n_ex, self.train_x, self.train_y, n_host
         with self.tracer.span("round.placement"):
-            if slab is not None:
-                idx, slab_x, slab_y = slab
-                train_x = self._put_data(jnp.asarray(slab_x))
-                train_y = self._put_data(jnp.asarray(slab_y))
+            if entry is not None and entry["placed"] is not None:
+                # double-buffered: the worker already placed this
+                # round's tensors while the previous dispatch ran —
+                # the placement span records only this hand-off
+                idx, mask, n_ex, train_x, train_y = entry["placed"]
+                self._db_stats["placed_prefetched"] += 1
             else:
-                train_x, train_y = self.train_x, self.train_y
-            if self._cohort_sharding is not None:
-                idx = self._put(idx, self._cohort_sharding)
-                # the [K, 2] spec has no batch dim — cohort-sharded only
-                mask = self._put(
-                    mask,
-                    self._client_sharding if self._spec_inputs
-                    else self._cohort_sharding,
+                idx, mask, n_ex, train_x, train_y = self._place_round_inputs(
+                    idx, mask, n_ex, slab
                 )
-                n_ex = self._put(n_ex, self._client_sharding)
         return cohort, idx, mask, n_ex, train_x, train_y, n_host
 
     def _round_comm(self, cohort, n_host) -> Dict[str, int]:
@@ -1726,8 +1873,18 @@ class Experiment:
         return os.path.join(self.cfg.run.out_dir or ".", self.cfg.name)
 
     def _stop_prefetch(self) -> None:
-        """Shut down the stream-mode host worker (no-op otherwise)."""
+        """Shut down the host prefetch worker (no-op when none ran).
+
+        Outstanding futures are CANCELLED before their keys are
+        dropped: with a second in-flight placed buffer, clearing the
+        dict alone would orphan a still-running future whose
+        device_put lands AFTER an abort/KeyboardInterrupt — masking
+        the ledger's final flush and racing the shutdown. A future
+        already executing cannot be cancelled; ``shutdown(wait=True)``
+        then blocks until it drains, so nothing runs past this call."""
         ex, self._host_executor = self._host_executor, None
+        for fut in self._prefetch.values():
+            fut.cancel()
         self._prefetch.clear()
         if ex is not None:
             ex.shutdown(wait=True, cancel_futures=True)
@@ -1867,6 +2024,7 @@ class Experiment:
         self._total_compiles = 0
         self._total_compile_ms = 0.0
         self._ledger_logged_round = -1
+        self._db_stats = {k: 0 for k in self._db_stats}
         # Checkpoint provenance baseline: only checkpoints written BY THIS
         # fit() call may be restored on retry — restoring a stale
         # checkpoint left in the same out_dir by an earlier run would
@@ -1943,6 +2101,12 @@ class Experiment:
                     ),
                     "compiles": int(self._total_compiles),
                     "compile_ms": round(self._total_compile_ms, 3),
+                    # double-buffer accounting: rounds whose host build
+                    # / device placement were served from the prefetch
+                    # buffers (i.e. hidden under the previous round's
+                    # dispatch), and drains where purity forced a
+                    # rebuild
+                    **{k: int(v) for k, v in self._db_stats.items()},
                     **{k: int(v) for k, v in self._run_totals.items()},
                 })
             except Exception as e:
@@ -2008,6 +2172,21 @@ class Experiment:
             )
         start_round = int(state["round"])
         self._rounds_done = max(self._rounds_done, start_round)
+        if start_round == 0:
+            # precision/fusion provenance: every throughput or MFU
+            # number read off this log is meaningless without the
+            # dtype policy it ran under (`colearn summarize` surfaces
+            # this record as its precision line)
+            self.logger.log({
+                "event": "precision",
+                "param_dtype": cfg.run.param_dtype,
+                "compute_dtype": cfg.run.compute_dtype,
+                "local_param_dtype": (
+                    cfg.run.local_param_dtype or cfg.run.param_dtype
+                ),
+                "fused_apply": bool(cfg.server.fused_apply),
+                "double_buffer": bool(self._double_buffer),
+            })
         if start_round == 0 and self._poisson:
             self.logger.log({
                 "event": "poisson_sampling",

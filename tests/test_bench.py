@@ -87,3 +87,34 @@ def test_gate_unknown_mfu_counts_as_dispatch_bound():
                             device_ms=2 * bench.DEVICE_MS_BASELINES[name],
                             mfu_pct=None)
     assert basis == "device_ms" and vs == 0.5
+
+
+def test_bench_shapes_validate_and_divide_fuse():
+    """Every bench shape's override set must validate against its named
+    config (a bad pairing — e.g. fuse not dividing the bench round
+    count — would kill the whole BENCH record at driver time)."""
+    from colearn_federated_learning_tpu.config import get_named_config
+
+    for name, (warmup, timed, overrides) in bench._SHAPES.items():
+        cfg = get_named_config(name)
+        cfg.server.num_rounds = warmup + timed
+        cfg.server.eval_every = 0
+        cfg.server.checkpoint_every = 0
+        cfg.run.out_dir = ""
+        cfg.apply_overrides(overrides)
+        cfg.validate()
+        fuse = cfg.run.fuse_rounds
+        assert warmup % fuse == 0 and timed % fuse == 0, (name, fuse)
+
+
+def test_mfu_basis_tracks_compute_dtype():
+    """r7 hygiene: bf16-compute configs divide by the bf16 peak, pure
+    f32 configs by the f32 stand-in — and the basis is recorded."""
+    from colearn_federated_learning_tpu.config import get_named_config
+
+    bf16 = get_named_config("cifar10_fedavg_100")
+    basis, peak = bench._mfu_basis(bf16)
+    assert basis == "bf16_peak" and peak == bench.PEAK_BF16_FLOPS
+    f32 = get_named_config("mnist_fedavg_2")
+    basis, peak = bench._mfu_basis(f32)
+    assert basis == "f32_peak" and peak == bench.PEAK_F32_FLOPS

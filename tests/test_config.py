@@ -56,3 +56,41 @@ def test_fedprox_requires_mu():
     cfg.client.prox_mu = 0.0
     with pytest.raises(ValueError):
         cfg.validate()
+
+
+def test_dtype_typos_rejected_with_allowed_values():
+    """r7 satellite: a dtype typo must fail at validate() with the
+    allowed values listed — not as a deep jnp.dtype/KeyError later."""
+    for field in ("param_dtype", "compute_dtype", "local_param_dtype"):
+        cfg = get_named_config("mnist_fedavg_2")
+        setattr(cfg.run, field, "bf16")
+        with pytest.raises(ValueError, match="bfloat16"):
+            cfg.validate()
+    # local_param_dtype additionally allows "" (inherit)
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.run.local_param_dtype = ""
+    cfg.validate()
+
+
+def test_bf16_off_tpu_warns_once(caplog):
+    """r7 satellite: requesting bf16 compute on a backend without
+    native bf16 matmuls (this CPU host) warns exactly once."""
+    import logging
+
+    from colearn_federated_learning_tpu.server import round_driver
+
+    round_driver._BF16_BACKEND_WARNED = False
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.run.compute_dtype = "bfloat16"
+    with caplog.at_level(logging.WARNING, logger=round_driver.__name__):
+        round_driver._warn_bf16_backend(cfg)
+        round_driver._warn_bf16_backend(cfg)
+    hits = [r for r in caplog.records if "bf16" in r.getMessage()]
+    assert len(hits) == 1
+    # pure-f32 configs never warn
+    round_driver._BF16_BACKEND_WARNED = False
+    caplog.clear()
+    f32 = get_named_config("mnist_fedavg_2")
+    with caplog.at_level(logging.WARNING, logger=round_driver.__name__):
+        round_driver._warn_bf16_backend(f32)
+    assert not caplog.records

@@ -1,0 +1,219 @@
+"""Double-buffered host↔device rounds (run.double_buffer, r7 —
+ROADMAP item 2 lever c).
+
+The contract: round inputs are pure in (seed, round[, ledger
+snapshot]), so a run whose host-input build AND device placement
+happen ahead on a worker thread is BITWISE the single-buffered run —
+including through a fused-chunk boundary, a shape-bucket rung change,
+an unaligned resume's fuse=1 catch-up (where the prefetched chunk-max
+grid must be drained and rebuilt), and an adaptive-sampler
+ledger-snapshot refresh (where the overlap must never build a cohort
+from a snapshot that does not exist yet). Plus the `_stop_prefetch`
+future-cancellation fix: an abort must not leave an orphaned future
+placing slabs after shutdown.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _cfg(double_buffer, rounds=6, fuse=1, out="", **over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 8
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    cfg.data.max_examples_per_client = 32
+    cfg.client.batch_size = 8
+    cfg.server.cohort_size = 2
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.run.out_dir = out
+    cfg.run.fuse_rounds = fuse
+    cfg.run.metrics_flush_every = 2
+    cfg.run.double_buffer = double_buffer
+    for k, v in over.items():
+        cfg.apply_overrides({k: v})
+    return cfg.validate()
+
+
+def _fit(cfg, state=None):
+    exp = Experiment(cfg, echo=False)
+    return exp, exp.fit(state)
+
+
+def _params_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+def test_double_buffer_bitwise_and_buffers_engaged(tmp_path):
+    """Buffered ≡ unbuffered bitwise, and every round after the first
+    was actually served from the placed prefetch buffer — which is what
+    makes the round.host_inputs/round.placement spans collapse to a
+    hand-off under round.dispatch (the PR 2 span taxonomy proof)."""
+    eb, on = _fit(_cfg(True))
+    es, off = _fit(_cfg(False))
+    _params_equal(on["params"], off["params"])
+    # rounds 1..5 prefetched+placed ahead; round 0 has no predecessor
+    assert eb._db_stats["placed_prefetched"] == 5
+    assert eb._db_stats["host_prefetched"] == 5
+    assert eb._db_stats["prefetch_dropped"] == 0
+    assert es._db_stats["placed_prefetched"] == 0
+
+
+def test_double_buffer_fused_chunks_bitwise(tmp_path):
+    """Chunk-boundary safety: under fuse_rounds the worker builds the
+    next chunk's host slabs ahead (placement stays with the chunk
+    stacker) and the result is bitwise the unbuffered fused run AND the
+    unfused run."""
+    _, on = _fit(_cfg(True, fuse=2))
+    _, off = _fit(_cfg(False, fuse=2))
+    _, plain = _fit(_cfg(True, fuse=1))
+    _params_equal(on["params"], off["params"])
+    _params_equal(on["params"], plain["params"])
+
+
+def test_double_buffer_unaligned_resume_drains(tmp_path):
+    """A warm start off a chunk boundary dispatches fuse=1 catch-up
+    rounds on their OWN grid; with shape buckets the prefetched
+    chunk-max entry is a mismatch the consumer must DROP and rebuild —
+    and the resumed run must still equal the straight run bitwise."""
+    over = {
+        "data.partition": "dirichlet", "data.dirichlet_alpha": 0.3,
+        "run.host_pipeline": "numpy",
+        "run.shape_buckets.enabled": True,
+        "run.shape_buckets.base": 2.0, "run.shape_buckets.count": 3,
+    }
+    _, straight = _fit(_cfg(True, rounds=4, fuse=2, **over))
+    # warm start at round 1 (not a fuse=2 boundary): one catch-up round
+    exp = Experiment(_cfg(True, rounds=4, fuse=2, **over), echo=False)
+    state = exp.init_state()
+    state = exp._place_state(state)
+    state = exp.run_round(state, 0, fuse_override=1)
+    state.pop("_metrics")
+    exp2, resumed = _fit(_cfg(True, rounds=4, fuse=2, **over), state)
+    _params_equal(straight["params"], resumed["params"])
+
+
+def test_double_buffer_bucket_rungs_bitwise(tmp_path):
+    """Shape buckets: the worker prefetches each round's own ladder
+    rung (pure in seed+round), so bucketed buffered ≡ bucketed
+    unbuffered bitwise across rung changes."""
+    over = {
+        "data.partition": "dirichlet", "data.dirichlet_alpha": 0.3,
+        "run.host_pipeline": "numpy",
+        "run.shape_buckets.enabled": True,
+        "run.shape_buckets.base": 2.0, "run.shape_buckets.count": 3,
+    }
+    eb, on = _fit(_cfg(True, **over))
+    _, off = _fit(_cfg(False, **over))
+    _params_equal(on["params"], off["params"])
+    assert eb._db_stats["prefetch_dropped"] == 0
+
+
+def test_double_buffer_adaptive_snapshot_drains(tmp_path):
+    """Adaptive sampling: the cohort after a ledger-snapshot refresh
+    depends on a snapshot the prefetch worker must NOT run ahead of.
+    The window guard drains the overlap at every log_every boundary;
+    schedules and params stay bitwise equal to the unbuffered run."""
+    over = {
+        "server.sampling": "adaptive",
+        "run.obs.client_ledger.enabled": True,
+        "run.obs.client_ledger.log_every": 2,
+        "run.host_pipeline": "numpy",
+    }
+    eb, on = _fit(_cfg(True, out=str(tmp_path / "on"), **over))
+    es, off = _fit(_cfg(False, out=str(tmp_path / "off"), **over))
+    _params_equal(on["params"], off["params"])
+    _params_equal(on["ledger"], off["ledger"])
+    # 6 rounds, refresh at 2 and 4: rounds 2 and 4 were never
+    # prefetched (the drain), the other post-0 rounds were
+    assert eb._db_stats["placed_prefetched"] == 3
+    assert eb._db_stats["prefetch_dropped"] == 0
+
+
+def test_stop_prefetch_cancels_outstanding_futures():
+    """The r7 fix: _stop_prefetch must cancel queued futures before
+    clearing the dict — with two in-flight buffers, clearing alone
+    orphans a future that can place a slab after abort and mask the
+    ledger's final flush."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    exp = Experiment(_cfg(True, rounds=4), echo=False)
+    started = threading.Event()
+    release = threading.Event()
+    ran = []
+
+    def slow():
+        started.set()
+        release.wait(timeout=10)
+        return "slow"
+
+    def queued():
+        ran.append(True)
+        return "queued"
+
+    exp._host_executor = ThreadPoolExecutor(max_workers=1)
+    f_running = exp._host_executor.submit(slow)
+    f_queued = exp._host_executor.submit(queued)
+    exp._prefetch = {1: f_running, 2: f_queued}
+    started.wait(timeout=10)
+    release.set()  # let the running one drain; the queued one must die
+    exp._stop_prefetch()
+    assert exp._host_executor is None
+    assert exp._prefetch == {}
+    assert f_queued.cancelled()
+    assert not ran  # the queued future never executed
+
+
+def test_run_summary_records_prefetch_stats(tmp_path):
+    import json
+    import os
+
+    cfg = _cfg(True, out=str(tmp_path))
+    _, _ = _fit(cfg)
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    summary = [r for r in recs if r.get("event") == "run_summary"][-1]
+    assert summary["placed_prefetched"] == 5
+    assert summary["prefetch_dropped"] == 0
+    # span taxonomy proof: the host phases were spanned every round but
+    # their critical-path time (now a buffer hand-off) sits far below
+    # the dispatched compute they hide under
+    phases = {}
+    for r in recs:
+        if r.get("event") == "spans":
+            for name, agg in r["phases"].items():
+                cur = phases.setdefault(name, 0.0)
+                phases[name] = cur + agg["total_ms"]
+    assert "round.host_inputs" in phases and "round.placement" in phases
+    assert phases["round.placement"] < phases["round.dispatch"]
+
+
+def test_fedbuff_and_stream_keep_legacy_behavior(tmp_path):
+    """fedbuff's queue scheduler is not buffered; stream placement
+    keeps its one-ahead build-only prefetch (no placed slabs — the
+    bounded-memory promise)."""
+    cfg = _cfg(True, rounds=4, **{
+        "algorithm": "fedbuff", "client.momentum": 0.0,
+    })
+    exp, _ = _fit(cfg)
+    assert not exp._double_buffer
+    assert exp._db_stats["placed_prefetched"] == 0
+
+    scfg = _cfg(True, rounds=4, **{"data.placement": "stream"})
+    sexp, s_on = _fit(scfg)
+    assert sexp._db_stats["placed_prefetched"] == 0  # build-only
+    assert sexp._db_stats["host_prefetched"] > 0
+    _, s_off = _fit(_cfg(False, rounds=4, **{"data.placement": "stream"}))
+    _params_equal(s_on["params"], s_off["params"])

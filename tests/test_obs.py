@@ -476,3 +476,24 @@ def test_trace_export_size_warning_once(tmp_path, caplog, monkeypatch):
         tracer.export(str(tmp_path / "t2.json"))
     warns = [r for r in caplog.records if "exported trace" in r.message]
     assert len(warns) == 1  # warn-once per tracer
+
+
+def test_summarize_surfaces_precision_line(tmp_path):
+    """r7: every run logs a `precision` record at fit start; summarize
+    renders it as the compute_dtype column next to the throughput."""
+    from colearn_federated_learning_tpu.obs.summary import (
+        format_summary,
+        summarize_records,
+    )
+
+    recs = [
+        {"schema": 1, "event": "precision", "param_dtype": "float32",
+         "compute_dtype": "bfloat16", "local_param_dtype": "bfloat16",
+         "fused_apply": True, "double_buffer": True},
+        {"schema": 1, "round": 1, "train_loss": 1.0, "examples": 8.0},
+    ]
+    summary = summarize_records(recs)
+    assert summary["precision"]["compute_dtype"] == "bfloat16"
+    text = format_summary(summary)
+    assert "precision: compute=bfloat16  params=float32" in text
+    assert "fused_apply" in text and "double_buffer" in text

@@ -496,3 +496,68 @@ class TestFusedRounds:
         cfg.server.compression = "qsgd"
         cfg.server.error_feedback = True
         cfg.validate()
+
+
+class TestBF16ComputeParity:
+    """The bf16-compute/f32-master headline policy (r7, ROADMAP item 2
+    lever a): run.compute_dtype=bfloat16 + run.local_param_dtype=
+    bfloat16 with f32 server params. Local matmuls/activations and the
+    per-step SGD run bf16 end-to-end (make_loss_fn normalizes inputs
+    straight into the model's compute dtype); the delta upcast, the
+    aggregation psum, and the server trajectory stay f32. Parity
+    contract, documented here and in docs/DESIGN.md: fused↔unfused is
+    BITWISE (same program, scanned); sharded↔sequential holds at
+    atol 1e-4 / rtol 1e-3 — the engines accumulate the same f32 deltas
+    in different orders, and each reassociation sits next to
+    bf16-rounded values (measured 0.0 on this config/backend; the band
+    leaves room for lane-count and backend reassociation)."""
+
+    def _run(self, engine="sharded", fuse=1, **over):
+        from colearn_federated_learning_tpu.config import get_named_config
+        from colearn_federated_learning_tpu.server.round_driver import (
+            Experiment,
+        )
+
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.data.num_clients = 8
+        cfg.server.cohort_size = 4
+        cfg.server.num_rounds = 4
+        cfg.server.eval_every = 0
+        cfg.run.out_dir = ""
+        cfg.run.engine = engine
+        cfg.run.fuse_rounds = fuse
+        cfg.run.compute_dtype = "bfloat16"
+        cfg.run.local_param_dtype = "bfloat16"
+        cfg.data.synthetic_train_size = 256
+        cfg.data.synthetic_test_size = 64
+        cfg.data.max_examples_per_client = 32
+        for k, v in over.items():
+            cfg.apply_overrides({k: v})
+        cfg.validate()
+        exp = Experiment(cfg, echo=False)
+        return exp.fit()
+
+    def test_master_params_stay_f32(self):
+        state = self._run()
+        for leaf in jax.tree.leaves(state["params"]):
+            assert leaf.dtype == jnp.float32
+
+    def test_fused_equals_unfused_bitwise_under_bf16(self):
+        a = self._run(fuse=1)
+        b = self._run(fuse=2)
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)
+            ),
+            a["params"], b["params"],
+        )
+
+    def test_sharded_matches_sequential_under_bf16(self):
+        sh = self._run("sharded")
+        sq = self._run("sequential")
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), atol=1e-4, rtol=1e-3
+            ),
+            sh["params"], sq["params"],
+        )
