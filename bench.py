@@ -70,29 +70,28 @@ DEVICE_MS_BASELINES = {
 # the JSON; the device-time pass runs for every pinned config)
 DISPATCH_BOUND_MFU_PCT = 5.0
 
-# Dense bf16 peak of one TPU v5e (v5 lite) chip. MFU = achieved/peak; the
-# FLOP count comes from XLA's cost model of ONE scan-free train step
-# (fwd+bwd on one batch) × steps × cohort — see _round_flops for why the
-# whole-round program can't be cost-analyzed directly.
-PEAK_BF16_FLOPS = 197e12
-# f32-compute denominator (mfu_basis hygiene, r7): a config whose train
-# step runs f32 matmuls must not have its MFU measured against the bf16
-# peak — the MXU retires f32 products at no better than half the bf16
-# rate, so bf16/2 is the conventional (and still optimistic) stand-in
-# for the unpublished v5e f32 peak. All shipped TPU configs run bf16
-# compute, so this branch is a guard, not a hot path; `mfu_basis` in
-# every result's extra records which denominator produced the number.
-PEAK_F32_FLOPS = PEAK_BF16_FLOPS / 2
+# Chip peaks + the MFU-basis rule live in obs/roofline.py now (r8): the
+# bench, the driver's `phase_cost_model` records, and `colearn mfu`'s
+# waterfall all divide by the SAME denominators — a drifted copy here
+# would make the waterfall's components stop summing to this headline.
+# Re-exported under the established names (tests pin them).
+from colearn_federated_learning_tpu.obs.roofline import (  # noqa: E402
+    PEAK_BF16_FLOPS,
+    PEAK_F32_FLOPS,
+    mfu_basis as _roofline_mfu_basis,
+)
 
 
 def _mfu_basis(cfg):
     """(basis name, peak FLOP/s) from the config's effective compute
     precision: the matmuls run bf16 when either the model compute dtype
-    or the effective local-param dtype is bfloat16."""
-    eff_local = cfg.run.local_param_dtype or cfg.run.param_dtype
-    if "bfloat16" in (cfg.run.compute_dtype, eff_local):
-        return "bf16_peak", PEAK_BF16_FLOPS
-    return "f32_peak", PEAK_F32_FLOPS
+    or the effective local-param dtype is bfloat16 (the shared
+    obs/roofline.py rule — `mfu_basis` in every result's extra records
+    which denominator produced the number)."""
+    return _roofline_mfu_basis(
+        cfg.run.compute_dtype, cfg.run.local_param_dtype,
+        cfg.run.param_dtype,
+    )
 
 # Per-config bench shape: (warmup rounds, timed rounds, extra overrides).
 # Overrides only bound BENCH COST (round count, per-client caps, eval

@@ -530,6 +530,20 @@ class ObsConfig:
     # upload/download, pre/post compression — obs/counters.py) merged
     # into each round's JSONL record.
     counters: bool = True
+    # Per-round analytic phase-cost records (obs/roofline.py): FLOPs +
+    # HBM bytes per round-program stage (local train / attack /
+    # aggregation / server apply / ledger stats), logged as
+    # `phase_cost` JSONL records next to the spans and joined by
+    # `colearn mfu <run>` into the MFU waterfall. Pure-function model
+    # (engine-invariant); requires counters. Centralized rounds only —
+    # gossip/fedbuff rounds carry no phase_cost record.
+    phase_cost: bool = True
+    # Where the local-train step FLOP count comes from:
+    #   analytic — dense 6·P·B approximation, zero extra compiles
+    #   xla      — XLA's cost model of one scan-free train step (what
+    #              bench.py's model_tflops_per_round uses; one extra
+    #              compile at fit start, exact for conv models)
+    phase_cost_flops: str = "analytic"  # analytic | xla
     # Poll jax device memory stats at flush boundaries and log a
     # `device_memory` record (in-use / peak / limit bytes). Off by
     # default: the gauges are per-process globals, noisy under tests.
@@ -1414,6 +1428,11 @@ class ExperimentConfig:
             raise ValueError(
                 f"run.obs.trace_max_events must be >= 0, "
                 f"got {obs.trace_max_events}"
+            )
+        if obs.phase_cost_flops not in ("analytic", "xla"):
+            raise ValueError(
+                f"unknown run.obs.phase_cost_flops "
+                f"{obs.phase_cost_flops!r}; expected 'analytic' or 'xla'"
             )
         cl = obs.client_ledger
         if not 0.0 < cl.ema <= 1.0:

@@ -16,6 +16,12 @@ Three pillars, each its own module, all host-side and engine-agnostic:
   flags scattered into a device-resident per-client store, periodic
   ``client_ledger`` JSONL records, and the ``colearn clients``
   attack-attribution report.
+- :mod:`roofline` — the performance observatory: an analytic per-phase
+  FLOP/HBM-byte cost model (``phase_cost`` JSONL records, engine-
+  parity-pinned like the wire counters), the ``colearn mfu`` waterfall
+  that decomposes headline MFU into padding/host/non-matmul/residual
+  components, and the ``colearn bench-report`` trajectory gates over
+  ``BENCH_r*.json`` + the checked-in ``BENCH_BUDGETS.json``.
 
 Everything is configured through the ``run.obs`` config block
 (:class:`~colearn_federated_learning_tpu.config.ObsConfig`); the
@@ -40,5 +46,14 @@ from colearn_federated_learning_tpu.obs.ledger import (  # noqa: F401
     STAT_COLS,
     client_round_stats,
     update_ledger,
+)
+from colearn_federated_learning_tpu.obs.roofline import (  # noqa: F401
+    PEAK_BF16_FLOPS,
+    PEAK_F32_FLOPS,
+    PEAK_HBM_BYTES_PER_SEC,
+    analytic_step_flops,
+    mfu_basis,
+    round_phase_costs,
+    waterfall,
 )
 from colearn_federated_learning_tpu.obs.spans import Tracer  # noqa: F401

@@ -1,0 +1,658 @@
+"""Per-phase FLOP/HBM-byte cost model, MFU waterfall, and the bench
+regression observatory.
+
+The headline bench emits ONE number (``mfu_pct``) and ROADMAP item 2
+asks where the other ~59% goes. This module turns that scalar into an
+attributable breakdown using the same discipline as the wire-byte
+counters (obs/counters.py): an **analytic cost model** — a pure
+function of the config and the round's realized shapes, never a
+measurement — joined with measured span timings. Purity is what makes
+the sharded and sequential engines agree bit-for-bit on every
+``phase_cost`` record (pinned by ``tests/test_roofline.py``), and what
+lets ``colearn mfu`` decompose a finished run from its JSONL alone.
+
+Three layers, all pure stdlib (the CLI imports this before any jax
+backend initialization):
+
+1. **Cost model** — :func:`round_phase_costs`: analytic FLOPs and
+   HBM bytes moved per round-program stage (local train fwd/bwd,
+   attack transform, aggregation, server apply incl. the Pallas fused
+   path, ledger stats). The local-train FLOP count reuses the bench's
+   ``model_tflops_per_round`` machinery: either XLA's cost analysis of
+   one scan-free train step (``run.obs.phase_cost_flops="xla"``) or
+   the dense 6·P·B approximation (default — no extra compile).
+2. **Waterfall** — :func:`waterfall`: headline MFU decomposed into
+   effective compute, padding loss (``padded_step_fraction`` dead
+   steps), non-matmul compute (the cost model's non-train phases at
+   roofline speed), host-exposed time (spans not hidden under
+   ``round.dispatch``), and residual kernel inefficiency. The
+   components sum to 100% of wall time within
+   :data:`WATERFALL_TOL_PCT` — the waterfall identity — and
+   ``effective + padding == headline`` by the same tolerance.
+3. **Observatory** — :func:`load_bench_history` /
+   :func:`bench_report`: the ``BENCH_r*.json`` trajectory with
+   per-phase deltas vs best-so-far and budget gates from a checked-in
+   baseline file (``BENCH_BUDGETS.json``), generalizing bench.py's
+   scalar device-ms ``_gate`` to per-phase budgets so the next plateau
+   is localized to a phase the moment it appears. Historical entries
+   that predate a field render ``n/a`` — never a KeyError.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# peaks (single source of truth — bench.py imports these)
+# ---------------------------------------------------------------------------
+
+# Dense bf16 peak of one TPU v5e (v5 lite) chip; MFU = achieved / peak.
+PEAK_BF16_FLOPS = 197e12
+# The MXU retires f32 products at no better than half the bf16 rate, so
+# bf16/2 is the conventional (and still optimistic) stand-in for the
+# unpublished v5e f32 peak. `mfu_basis` records which denominator
+# produced every number — a bf16 measurement silently compared against
+# an f32 peak is the exact hygiene failure the basis exists to stop.
+PEAK_F32_FLOPS = PEAK_BF16_FLOPS / 2
+# HBM bandwidth of one v5e chip — the roof the memory-bound phases hit.
+PEAK_HBM_BYTES_PER_SEC = 819e9
+
+# Waterfall identity tolerance, in MFU percentage points: the
+# components are computed from three independent record streams
+# (analytic phase costs, measured spans, measured rounds/sec), so the
+# identity holds only up to their rounding (record fields are rounded
+# to 3-4 decimals at log time).
+WATERFALL_TOL_PCT = 0.5
+
+# The cost-model phase taxonomy, in round-program order. Matches the
+# engines' jax.named_scope annotations (round_local_train,
+# round_attack_transform, round_aggregate, round_server_apply /
+# round_fused_reduce_apply, round_client_ledger) so device profiles
+# join with the analytic model by name.
+PHASES = (
+    "local_train",
+    "attack_transform",
+    "aggregation",
+    "server_apply",
+    "ledger_stats",
+)
+
+# Span phases that do NOT count as host-exposed time: `round` is the
+# parent bracket, `round.dispatch` is where async device execution is
+# buried, and `compile` fires INSIDE the dispatch call that triggered
+# it (counting it again would double-book that wall). Every other span
+# (host_inputs, placement, fetch, eval, checkpoint, stream_slab, ...)
+# is host time the device sits idle through.
+_NON_HOST_EXPOSED_SPANS = ("round", "round.dispatch", "compile")
+
+# Byte-model pass counts (documented constants, not magic numbers):
+# local train touches the params 4× per step (fwd read, bwd read, grad
+# write, local-SGD update write) — activation traffic is workload-
+# dependent and excluded, so local-train bytes are a floor (harmless:
+# the phase is compute-bound by orders of magnitude anyway).
+LOCAL_TRAIN_PARAM_PASSES = 4
+# Unfused server apply is a chain of separate XLA ops (trust/weight
+# scale → reduction output materialized → delta apply → optimizer),
+# each re-reading its operands from HBM: read delta, read params, read
+# momentum, write momentum, write params, plus the materialized
+# intermediate — 6 params-sized passes.
+SERVER_APPLY_PASSES_UNFUSED = 6
+# The Pallas fused path (ops/pallas_apply.py) runs the same chain as
+# ONE VMEM-resident pass: read params + momentum, write params +
+# momentum — 4 passes, and the mean-delta intermediate (1 write + 1
+# re-read in `aggregation`) never touches HBM at all.
+SERVER_APPLY_PASSES_FUSED = 4
+
+
+def mfu_basis(compute_dtype: str, local_param_dtype: Optional[str],
+              param_dtype: str) -> tuple:
+    """(basis name, peak FLOP/s) from the effective compute precision:
+    the matmuls run bf16 when either the model compute dtype or the
+    effective local-param dtype is bfloat16. Pure so bench.py and the
+    driver's ``phase_cost_model`` record derive the identical basis."""
+    eff_local = local_param_dtype or param_dtype
+    if "bfloat16" in (compute_dtype, eff_local):
+        return "bf16_peak", PEAK_BF16_FLOPS
+    return "f32_peak", PEAK_F32_FLOPS
+
+
+def peak_for_basis(basis: str) -> float:
+    return PEAK_BF16_FLOPS if basis == "bf16_peak" else PEAK_F32_FLOPS
+
+
+def analytic_step_flops(n_coords: int, batch_units: int) -> int:
+    """Dense fwd+bwd FLOPs of one train step: 2·P per unit forward,
+    2× that backward — the standard 6·P·B approximation. ``batch_units``
+    is examples × tokens-per-example for sequence models. Under-counts
+    convolutional re-use (a conv layer applies its kernel per spatial
+    position); the XLA-counted alternative (``phase_cost_flops="xla"``)
+    is exact but costs one extra compile per run."""
+    return 6 * int(n_coords) * int(batch_units)
+
+
+# ---------------------------------------------------------------------------
+# the analytic per-phase cost model
+# ---------------------------------------------------------------------------
+
+
+def round_phase_costs(*, k: int, steps: int, batch: int, n_coords: int,
+                      compute_bytes: int, step_flops: int,
+                      aggregator: str = "weighted_mean",
+                      attack: bool = False, ledger: bool = False,
+                      reputation: bool = False,
+                      fused_apply: bool = False,
+                      host_input_bytes: int = 0) -> Dict[str, Dict[str, int]]:
+    """Analytic FLOPs + HBM bytes per round-program stage for one
+    centralized round on the **padded** ``steps × batch`` grid (the
+    same grid headline MFU counts — padding waste is attributed by the
+    waterfall, not hidden here).
+
+    Same honesty contract as :func:`~colearn_federated_learning_tpu.
+    obs.counters.round_comm_bytes`: these are the FLOPs/bytes the
+    configured round program WOULD execute/move — a pure function of
+    the config and the realized grid, identical across the sharded,
+    sequential, and fused engines by construction.
+
+    Only phases the config actually runs appear in the result. Wire
+    stacks and aggregation intermediates are f32 (4 B); server params/
+    momentum are f32 master; local-train compute traffic moves at
+    ``compute_bytes`` (2 under bf16 compute).
+    """
+    k, steps, batch = int(k), int(steps), int(batch)
+    n, cb = int(n_coords), int(compute_bytes)
+    out: Dict[str, Dict[str, int]] = {}
+
+    # local train: the matmul phase. step_flops is fwd+bwd of ONE batch.
+    out["local_train"] = {
+        "flops": int(step_flops) * steps * k,
+        "bytes": (steps * k * LOCAL_TRAIN_PARAM_PASSES * n * cb
+                  + int(host_input_bytes)),
+    }
+
+    if attack:
+        # elementwise transform over the [K, n] wire stack (sign flip /
+        # scale / noise add): 2 flops/coord, read + write at f32
+        out["attack_transform"] = {
+            "flops": 2 * k * n,
+            "bytes": 2 * k * n * 4,
+        }
+
+    if aggregator == "krum":
+        # pairwise squared distances over the stack: K(K-1)/2 ordered
+        # pairs × (sub, mul, add)/coord; each pair reads two vectors
+        pairs = k * (k - 1) // 2
+        agg_flops = 3 * pairs * n
+        agg_bytes = 2 * pairs * n * 4
+        # + the winner's delta materialized (one-hot weighted reduce)
+        agg_flops += 2 * k * n
+        agg_bytes += k * n * 4
+    elif aggregator in ("median", "trimmed_mean"):
+        # coordinate-wise sort network over K values: ~K·ceil(log2 K)
+        # compare-exchanges per coordinate, stack read + sorted write
+        agg_flops = k * max(1, math.ceil(math.log2(max(k, 2)))) * n
+        agg_bytes = 2 * k * n * 4
+    else:  # weighted_mean
+        # multiply-accumulate over the stack (or the psum-equivalent)
+        agg_flops = 2 * k * n
+        agg_bytes = k * n * 4
+    if reputation:
+        # trust enters as one extra multiply per stack coordinate
+        agg_flops += k * n
+    if not (fused_apply and aggregator in ("weighted_mean", "krum")):
+        # the mean delta materializes to HBM and server_apply re-reads
+        # it; under the fused Pallas path the reduction output stays in
+        # VMEM, so these two passes are exactly the fused saving
+        agg_bytes += 2 * n * 4
+    out["aggregation"] = {"flops": agg_flops, "bytes": agg_bytes}
+
+    # server apply: delta scale + momentum update + param apply —
+    # elementwise over the f32 master params
+    fused = fused_apply and aggregator in ("weighted_mean", "krum")
+    passes = (SERVER_APPLY_PASSES_FUSED if fused
+              else SERVER_APPLY_PASSES_UNFUSED)
+    out["server_apply"] = {
+        "flops": 4 * n,
+        "bytes": passes * n * 4,
+    }
+
+    if ledger:
+        # per-client stats over the wire stack (obs/ledger.py): L2 norm
+        # (2·n), dot with the mean delta (2·n), residual norm (2·n) per
+        # client; the stack is re-read once and the mean delta K times
+        # in principle but streams — counted once per client
+        out["ledger_stats"] = {
+            "flops": 6 * k * n,
+            "bytes": 2 * k * n * 4,
+        }
+    return out
+
+
+def phase_time_s(cost: Dict[str, int], peak_flops: float,
+                 peak_bw: float = PEAK_HBM_BYTES_PER_SEC) -> float:
+    """Roofline execution-time floor of one phase: whichever roof —
+    compute or memory — binds."""
+    return max(cost["flops"] / peak_flops, cost["bytes"] / peak_bw)
+
+
+def classify_phase(cost: Dict[str, int], peak_flops: float,
+                   peak_bw: float = PEAK_HBM_BYTES_PER_SEC) -> str:
+    """``compute`` vs ``memory`` bound: arithmetic intensity
+    (flops/byte) against the ridge point of the configured roofline."""
+    if cost["bytes"] <= 0:
+        return "compute"
+    ridge = peak_flops / peak_bw
+    return "compute" if cost["flops"] / cost["bytes"] >= ridge else "memory"
+
+
+# ---------------------------------------------------------------------------
+# the MFU waterfall
+# ---------------------------------------------------------------------------
+
+WATERFALL_COMPONENTS = (
+    "effective_compute",
+    "padding",
+    "non_matmul",
+    "host_exposed",
+    "residual",
+)
+
+
+def waterfall(phase_costs: Dict[str, Dict[str, int]],
+              rounds_per_sec: float, peak_flops: float, n_chips: int = 1,
+              padded_step_fraction: float = 0.0,
+              host_exposed_ms_per_round: float = 0.0,
+              peak_bw: float = PEAK_HBM_BYTES_PER_SEC) -> Dict[str, Any]:
+    """Decompose headline MFU into the waterfall components, each in
+    percent of wall time (so they sum to 100).
+
+    - ``headline_mfu_pct`` — the bench's number: padded-grid local-
+      train FLOPs × rounds/sec ÷ peak.
+    - ``effective_compute`` + ``padding`` — the headline split by
+      ``padded_step_fraction`` (dead scan steps burn full-step FLOPs).
+    - ``non_matmul`` — the cost model's non-train phases at roofline
+      speed (each phase's max(compute, memory) floor).
+    - ``host_exposed`` — measured span time NOT hidden under
+      ``round.dispatch`` (host inputs, placement, fetch, eval,
+      checkpoint, compile), per round.
+    - ``residual`` — whatever wall time remains: kernel inefficiency,
+      pipeline bubbles, and every un-modeled stall. Negative residual
+      beyond :data:`WATERFALL_TOL_PCT` means the model over-accounts
+      the measured wall and is surfaced, never clamped away.
+    """
+    if rounds_per_sec <= 0:
+        raise ValueError("rounds_per_sec must be > 0 for a waterfall")
+    wall_s = 1.0 / rounds_per_sec
+    chips = max(1, int(n_chips))
+    train_flops = phase_costs.get("local_train", {}).get("flops", 0)
+    headline = 100.0 * train_flops / (wall_s * peak_flops * chips)
+    padding = headline * float(padded_step_fraction)
+    effective = headline - padding
+    non_matmul_s = sum(
+        phase_time_s(c, peak_flops, peak_bw) / chips
+        for name, c in phase_costs.items() if name != "local_train"
+    )
+    non_matmul = 100.0 * non_matmul_s / wall_s
+    host = 100.0 * (host_exposed_ms_per_round / 1000.0) / wall_s
+    residual = 100.0 - headline - non_matmul - host
+    return {
+        "headline_mfu_pct": headline,
+        "components": {
+            "effective_compute": effective,
+            "padding": padding,
+            "non_matmul": non_matmul,
+            "host_exposed": host,
+            "residual": residual,
+        },
+        "wall_ms_per_round": wall_s * 1000.0,
+    }
+
+
+def check_waterfall_identity(wf: Dict[str, Any],
+                             tol: float = WATERFALL_TOL_PCT) -> List[str]:
+    """The documented identity, as violations (empty = holds):
+    components sum to 100% of wall, effective + padding reconstructs
+    the headline, and no component over-accounts (residual may be
+    negative only within tolerance)."""
+    comp = wf["components"]
+    problems = []
+    total = sum(comp[k] for k in WATERFALL_COMPONENTS)
+    if abs(total - 100.0) > tol:
+        problems.append(f"components sum to {total:.3f}%, not 100%")
+    if abs(comp["effective_compute"] + comp["padding"]
+           - wf["headline_mfu_pct"]) > tol:
+        problems.append("effective + padding != headline MFU")
+    if comp["residual"] < -tol:
+        problems.append(
+            f"residual {comp['residual']:.3f}% < 0: the analytic model "
+            f"over-accounts the measured wall time"
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# `colearn mfu <run>` — the report over a run's JSONL records
+# ---------------------------------------------------------------------------
+
+
+def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Join a run's ``phase_cost_model`` / ``phase_cost`` / ``spans`` /
+    round records into the waterfall + roofline report. Raises
+    ValueError with an actionable message when the run predates the
+    observatory (no phase_cost records)."""
+    model = None
+    costs_sum: Dict[str, Dict[str, float]] = {}
+    costs_n = 0
+    span_ms: Dict[str, float] = {}
+    rps: List[float] = []
+    padded: List[float] = []
+    rounds = 0
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "phase_cost_model":
+            model = rec
+        elif ev == "phase_cost":
+            costs_n += 1
+            for name, c in (rec.get("phases") or {}).items():
+                cur = costs_sum.setdefault(name, {"flops": 0.0, "bytes": 0.0})
+                cur["flops"] += float(c.get("flops", 0))
+                cur["bytes"] += float(c.get("bytes", 0))
+        elif ev == "spans":
+            for name, agg in (rec.get("phases") or {}).items():
+                span_ms[name] = span_ms.get(name, 0.0) + float(
+                    agg.get("total_ms", 0.0)
+                )
+        elif ev is None and "round" in rec:
+            rounds = max(rounds, int(rec["round"]))
+            if "rounds_per_sec" in rec:
+                rps.append(float(rec["rounds_per_sec"]))
+            if "padded_step_fraction" in rec:
+                padded.append(float(rec["padded_step_fraction"]))
+    if model is None or not costs_n:
+        raise ValueError(
+            "no phase_cost records in this log (run.obs.phase_cost was "
+            "off, or the run predates the performance observatory)"
+        )
+    if not rps:
+        raise ValueError(
+            "no rounds_per_sec in this log (no completed flush window) "
+            "— cannot anchor the waterfall to wall time"
+        )
+    # mean analytic cost per round (varies only with bucket rungs /
+    # realized participation)
+    costs = {
+        name: {"flops": int(c["flops"] / costs_n),
+               "bytes": int(c["bytes"] / costs_n)}
+        for name, c in costs_sum.items()
+    }
+    peak = float(model.get("peak_flops") or
+                 peak_for_basis(model.get("mfu_basis", "bf16_peak")))
+    peak_bw = float(model.get("peak_hbm_bytes_per_sec")
+                    or PEAK_HBM_BYTES_PER_SEC)
+    n_chips = int(model.get("n_chips", 1))
+    host_ms = sum(
+        ms for name, ms in span_ms.items()
+        if name not in _NON_HOST_EXPOSED_SPANS
+    ) / max(1, rounds)
+    rps_mean = sum(rps) / len(rps)
+    wf = waterfall(
+        costs, rps_mean, peak, n_chips=n_chips,
+        padded_step_fraction=(sum(padded) / len(padded)) if padded else 0.0,
+        host_exposed_ms_per_round=host_ms, peak_bw=peak_bw,
+    )
+    roofline = {
+        name: {
+            **costs[name],
+            # None (not inf) when the phase moves no modeled bytes, so
+            # the --json output stays strict JSON
+            "intensity": (costs[name]["flops"] / costs[name]["bytes"]
+                          if costs[name]["bytes"] else None),
+            "bound": classify_phase(costs[name], peak, peak_bw),
+            "time_us_at_peak": phase_time_s(costs[name], peak, peak_bw)
+            / max(1, n_chips) * 1e6,
+        }
+        for name in PHASES if name in costs
+    }
+    return {
+        "rounds": rounds,
+        "rounds_per_sec": rps_mean,
+        "mfu_basis": model.get("mfu_basis", "n/a"),
+        "flop_source": model.get("flop_source", "n/a"),
+        "peak_tflops": peak / 1e12,
+        "peak_hbm_gbs": peak_bw / 1e9,
+        "n_chips": n_chips,
+        "waterfall": wf,
+        "identity_violations": check_waterfall_identity(wf),
+        "roofline": roofline,
+        "host_exposed_ms_per_round": host_ms,
+    }
+
+
+_WF_LABELS = {
+    "effective_compute": "effective compute",
+    "padding": "padding (dead steps)",
+    "non_matmul": "non-matmul compute",
+    "host_exposed": "host-exposed time",
+    "residual": "residual kernel inefficiency",
+}
+
+
+def format_mfu_report(report: Dict[str, Any], path: str = "") -> str:
+    wf = report["waterfall"]
+    lines = []
+    head = f"run: {path}" if path else "mfu report"
+    lines.append(
+        f"{head}  rounds: {report['rounds']}  "
+        f"wall/round: {wf['wall_ms_per_round']:.1f} ms  "
+        f"basis: {report['mfu_basis']} "
+        f"({report['peak_tflops']:.1f} TF/s, "
+        f"{report['peak_hbm_gbs']:.0f} GB/s HBM, "
+        f"{report['n_chips']} chip(s), {report['flop_source']} flops)"
+    )
+    lines.append(f"headline MFU: {wf['headline_mfu_pct']:.2f}%")
+    lines.append("")
+    lines.append(f"waterfall (% of wall time, sums to 100 "
+                 f"± {WATERFALL_TOL_PCT}):")
+    for name in WATERFALL_COMPONENTS:
+        lines.append(
+            f"  {_WF_LABELS[name]:<30}{wf['components'][name]:>8.2f}%"
+        )
+    for v in report["identity_violations"]:
+        lines.append(f"  WARNING: {v}")
+    roof = report.get("roofline") or {}
+    if roof:
+        lines.append("")
+        lines.append(
+            f"{'phase':<18}{'flops/round':>14}{'bytes/round':>14}"
+            f"{'flops/byte':>12}{'bound':>9}{'us@peak':>10}"
+        )
+        for name in PHASES:
+            if name not in roof:
+                continue
+            r = roof[name]
+            inten = ("inf" if r["intensity"] is None
+                     else f"{r['intensity']:.1f}")
+            lines.append(
+                f"{name:<18}{r['flops']:>14.3g}{r['bytes']:>14.3g}"
+                f"{inten:>12}{r['bound']:>9}{r['time_us_at_peak']:>10.1f}"
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# bench regression observatory (`colearn bench-report`)
+# ---------------------------------------------------------------------------
+
+
+def _na(v, fmt="{}"):
+    return "n/a" if v is None else fmt.format(v)
+
+
+def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
+    """Parse the ``BENCH_r*.json`` trajectory in ``bench_dir`` into
+    normalized entries, tolerant of every historical shape: entries
+    missing ``parsed`` (a failed bench run), and extras that predate
+    ``mfu_basis`` / ``compute_dtype`` / ``phase_ms`` / ``timed_rounds``
+    get ``None`` fields (rendered ``n/a``), never a KeyError."""
+    paths = sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")),
+        key=lambda p: (
+            int(m.group(1)) if (m := re.search(r"_r(\d+)", p)) else 0, p
+        ),
+    )
+    entries = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            entries.append({"file": os.path.basename(p), "n": None,
+                            "value": None, "error": "unreadable"})
+            continue
+        parsed = doc.get("parsed") or {}
+        extra = parsed.get("extra") or {}
+        timed = extra.get("timed_rounds")
+        phase_ms = extra.get("phase_ms")
+        phase_ms_per_round = None
+        if isinstance(phase_ms, dict) and timed:
+            phase_ms_per_round = {
+                k: float(v) / float(timed) for k, v in phase_ms.items()
+            }
+        entries.append({
+            "file": os.path.basename(p),
+            "n": doc.get("n"),
+            "value": parsed.get("value"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "mfu_pct": extra.get("mfu_pct"),
+            "effective_mfu_pct": extra.get("effective_mfu_pct"),
+            "mfu_basis": extra.get("mfu_basis"),
+            "compute_dtype": extra.get("compute_dtype"),
+            "device_ms_per_round": extra.get("device_ms_per_round"),
+            "timed_rounds": timed,
+            "phase_ms_per_round": phase_ms_per_round,
+            "padded_step_fraction": extra.get("padded_step_fraction"),
+        })
+    return entries
+
+
+DEFAULT_PHASE_REGRESSION_FACTOR = 1.25
+
+
+def bench_report(entries: Sequence[Dict[str, Any]],
+                 budgets: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Trajectory deltas + budget gates over a bench history.
+
+    ``budgets`` is the checked-in baseline (``BENCH_BUDGETS.json``):
+
+    - ``rounds_per_sec_min`` / ``mfu_pct_min`` — floors on the LATEST
+      entry (the scalar gates, generalized from bench.py's ``_gate``).
+    - ``phase_budget_ms`` — explicit per-phase ms/round ceilings.
+    - ``phase_regression_factor`` — for phases with no explicit budget,
+      the ceiling is best-so-far (earlier entries) × factor.
+
+    Gates never fire on ``n/a`` (a missing field is a provenance gap,
+    not a regression); they fire the moment the field exists and
+    exceeds its budget, naming the offending phase.
+    """
+    budgets = budgets or {}
+    factor = float(budgets.get("phase_regression_factor",
+                               DEFAULT_PHASE_REGRESSION_FACTOR))
+    explicit = budgets.get("phase_budget_ms") or {}
+    # best-so-far per phase over all but the latest measurable entry
+    measurable = [e for e in entries if e.get("value") is not None]
+    latest = measurable[-1] if measurable else None
+    best_phase: Dict[str, float] = {}
+    best_value = None
+    for e in measurable[:-1]:
+        if e.get("value") is not None:
+            best_value = max(best_value or 0.0, e["value"])
+        for ph, ms in (e.get("phase_ms_per_round") or {}).items():
+            if ph not in best_phase or ms < best_phase[ph]:
+                best_phase[ph] = ms
+    violations: List[str] = []
+    if latest is not None:
+        rps_min = budgets.get("rounds_per_sec_min")
+        if rps_min is not None and latest["value"] < float(rps_min):
+            violations.append(
+                f"rounds_per_sec {latest['value']:.3f} < budget floor "
+                f"{float(rps_min):.3f} ({latest['file']})"
+            )
+        mfu_min = budgets.get("mfu_pct_min")
+        if (mfu_min is not None and latest.get("mfu_pct") is not None
+                and latest["mfu_pct"] < float(mfu_min)):
+            violations.append(
+                f"mfu_pct {latest['mfu_pct']:.2f} < budget floor "
+                f"{float(mfu_min):.2f} ({latest['file']})"
+            )
+        for ph, ms in (latest.get("phase_ms_per_round") or {}).items():
+            if ph in explicit:
+                budget = float(explicit[ph])
+                src = "explicit budget"
+            elif ph in best_phase:
+                budget = best_phase[ph] * factor
+                src = f"best-so-far {best_phase[ph]:.2f} ms × {factor}"
+            else:
+                continue  # first appearance of the phase: becomes the pin
+            if ms > budget:
+                violations.append(
+                    f"phase {ph}: {ms:.2f} ms/round exceeds "
+                    f"{budget:.2f} ms ({src})"
+                )
+    return {
+        "entries": list(entries),
+        "latest": latest,
+        "best_phase_ms": best_phase,
+        "violations": violations,
+    }
+
+
+def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
+    entries = report["entries"]
+    lines = [
+        f"bench trajectory"
+        + (f" ({bench_dir})" if bench_dir else "")
+        + f": {len(entries)} entries"
+    ]
+    lines.append(
+        f"{'entry':<18}{'r/s':>8}{'vs_base':>9}{'mfu%':>8}"
+        f"{'basis':>11}{'dtype':>10}{'dev ms':>8}"
+    )
+    for e in entries:
+        lines.append(
+            f"{e['file']:<18}"
+            f"{_na(e.get('value'), '{:.3f}'):>8}"
+            f"{_na(e.get('vs_baseline'), '{:.3f}'):>9}"
+            f"{_na(e.get('mfu_pct'), '{:.2f}'):>8}"
+            f"{_na(e.get('mfu_basis')):>11}"
+            f"{_na(e.get('compute_dtype')):>10}"
+            f"{_na(e.get('device_ms_per_round'), '{:.1f}'):>8}"
+        )
+    latest = report.get("latest")
+    phases = (latest or {}).get("phase_ms_per_round")
+    if phases:
+        best = report.get("best_phase_ms") or {}
+        lines.append("")
+        lines.append(f"{'phase (latest)':<24}{'ms/round':>10}"
+                     f"{'best':>10}{'Δ vs best':>11}")
+        for ph in sorted(phases, key=lambda p: -phases[p]):
+            b = best.get(ph)
+            delta = ("n/a" if b is None or b == 0
+                     else f"{100.0 * (phases[ph] - b) / b:+.0f}%")
+            lines.append(
+                f"{ph:<24}{phases[ph]:>10.2f}"
+                f"{_na(b, '{:.2f}'):>10}{delta:>11}"
+            )
+    elif latest is not None:
+        lines.append("")
+        lines.append("per-phase ms: n/a (history predates phase_ms extras)")
+    lines.append("")
+    if report["violations"]:
+        lines.append("GATE FAILURES:")
+        lines.extend(f"  {v}" for v in report["violations"])
+    else:
+        lines.append("gates: PASS")
+    return "\n".join(lines)

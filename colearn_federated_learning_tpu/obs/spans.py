@@ -28,7 +28,7 @@ import os
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 # exported-trace size past which export() warns once: multi-GB
 # trace.json files load poorly (or not at all) in Perfetto and are
@@ -104,9 +104,15 @@ class Tracer:
     """
 
     def __init__(self, enabled: bool = True, trace: bool = False, clock=None,
-                 max_events: int = 0):
+                 max_events: int = 0, process_index: int = 0):
         self.enabled = enabled
         self.trace = trace and enabled
+        # multi-process runs: the process index IS the Chrome-trace pid,
+        # so each host gets its own lane group in Perfetto and
+        # :meth:`export` can merge per-host fragments into one timeline
+        # (os.getpid() would collide semantics across re-runs and says
+        # nothing about WHICH host a lane belongs to)
+        self.process_index = int(process_index)
         self._clock = clock or time.perf_counter
         self._lock = threading.Lock()
         self._agg: Dict[str, List[float]] = {}  # name -> [count, total_s, max_s]
@@ -154,7 +160,7 @@ class Tracer:
                 event = {
                     "name": name,
                     "ph": "X",
-                    "pid": os.getpid(),
+                    "pid": self.process_index,
                     "tid": threading.get_ident() & 0xFFFF,
                     "ts": (start - self._t0) * 1e6,  # µs, run-relative
                     "dur": dur * 1e6,
@@ -174,7 +180,7 @@ class Tracer:
                 self._append_event({
                     "name": "compile",
                     "ph": "X",
-                    "pid": os.getpid(),
+                    "pid": self.process_index,
                     "tid": threading.get_ident() & 0xFFFF,
                     # the monitoring hook fires at compile END; back-date
                     # the block so the timeline shows when it ran
@@ -234,20 +240,40 @@ class Tracer:
             }
         return out
 
-    def export(self, path: str) -> Optional[str]:
+    def export(self, path: str,
+               fragments: Sequence[str] = ()) -> Optional[str]:
         """Write the accumulated Chrome-trace events as a Perfetto-
         loadable ``trace.json`` (open at ui.perfetto.dev or
         chrome://tracing). Returns the path, or None when tracing is
-        off. Events are NOT cleared — export is an end-of-run dump."""
+        off. Events are NOT cleared — export is an end-of-run dump.
+
+        ``fragments`` are sibling trace files written by OTHER
+        processes of a multi-host run (the driver's ``trace.p<i>.json``
+        per-host exports): their events are merged into this export so
+        the timeline shows one lane group per host instead of silently
+        reflecting process 0 only. Unreadable fragments are skipped —
+        a host that crashed before exporting must not take down the
+        survivors' merged trace."""
         if not self.trace:
             return None
         with self._lock:
             events = list(self._events)
+        for frag in fragments:
+            try:
+                with open(frag) as f:
+                    frag_events = json.load(f).get("traceEvents", [])
+            except (OSError, ValueError):
+                continue
+            events.extend(
+                e for e in frag_events if e.get("ph") != "M"
+            )
+        lanes = sorted({e.get("pid", 0) for e in events} | {self.process_index})
         doc = {
             "displayTimeUnit": "ms",
             "traceEvents": [
-                {"ph": "M", "pid": os.getpid(), "name": "process_name",
-                 "args": {"name": "colearn round lifecycle"}},
+                *({"ph": "M", "pid": pid, "name": "process_name",
+                   "args": {"name": f"colearn host {pid} round lifecycle"}}
+                  for pid in lanes),
                 *events,
             ],
         }

@@ -61,7 +61,20 @@ _COUNTER_KEYS = (
 
 
 def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """Fold a run's records into one summary dict (see format_summary)."""
+    """Fold a run's records into one summary dict (see format_summary).
+
+    Totals come from the end-of-fit ``run_summary`` record when one
+    exists (the driver logs it on every exit path — aborts included —
+    exactly so consumers don't have to re-aggregate the whole JSONL);
+    per-round counter summation runs only as the fallback for logs
+    that predate it. ``summary["source"]`` records which path was
+    taken, and the rendered table prints it."""
+    # the authoritative totals record lives at the tail of the log —
+    # scan from the end so the fast path stays fast on long logs
+    run_sum = next(
+        (r for r in reversed(records) if r.get("event") == "run_summary"),
+        None,
+    )
     phases: Dict[str, Dict[str, float]] = {}
     counters: Dict[str, int] = {}
     health: Dict[str, int] = {}
@@ -102,9 +115,13 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             rounds = max(rounds, int(rec["round"]))
             if "rounds_per_sec" in rec:
                 rps.append(float(rec["rounds_per_sec"]))
-            for k in _COUNTER_KEYS:
-                if k in rec:
-                    counters[k] = counters.get(k, 0) + int(rec[k])
+            if run_sum is None:
+                # fallback only: pre-run_summary logs re-aggregate the
+                # per-round counters; newer logs take the totals from
+                # the authoritative record below
+                for k in _COUNTER_KEYS:
+                    if k in rec:
+                        counters[k] = counters.get(k, 0) + int(rec[k])
             dropped += int(rec.get("dropped_clients", 0))
             stragglers += int(rec.get("straggler_clients", 0))
             byzantine += int(rec.get("byzantine_count", 0))
@@ -115,9 +132,19 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "rounds": rounds,
         "phases": phases,
         "events": events,
+        "source": "run_summary" if run_sum is not None else "reaggregated",
     }
     if rps:
         out["rounds_per_sec_mean"] = sum(rps) / len(rps)
+    if run_sum is not None:
+        out["rounds"] = max(rounds, int(run_sum.get("rounds", 0)))
+        if "wall_time_sec" in run_sum:
+            out["wall_time_sec"] = float(run_sum["wall_time_sec"])
+        if "compiles" in run_sum:
+            out["compiles"] = int(run_sum["compiles"])
+        counters = {
+            k: int(run_sum[k]) for k in _COUNTER_KEYS if k in run_sum
+        }
     if counters:
         out["comm"] = counters
     if dropped or stragglers or byzantine:
@@ -151,6 +178,17 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
     if "rounds_per_sec_mean" in summary:
         head += f"  rounds/sec (window mean): {summary['rounds_per_sec_mean']:.3f}"
     lines.append(head)
+    # which totals path produced the numbers below — the run_summary
+    # record when the log carries one, per-round re-aggregation only
+    # for logs that predate it
+    src = summary.get("source", "reaggregated")
+    src_line = (
+        "totals: run_summary record" if src == "run_summary"
+        else "totals: re-aggregated per-round (log predates run_summary)"
+    )
+    if "wall_time_sec" in summary:
+        src_line += f"  wall: {summary['wall_time_sec']:.1f}s"
+    lines.append(src_line)
     prec = summary.get("precision")
     if prec:
         bits = [

@@ -1297,10 +1297,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 apply_upload_attack,
             )
 
-            deltas = apply_upload_attack(
-                deltas, byz, keys, attack, attack_scale, attack_eps,
-                participation=n_ex > 0,
-            )
+            # scope name matches the obs/roofline.py cost-model phase
+            # (`attack_transform`) so device profiles join the analytic
+            # FLOP/byte model by name
+            with jax.named_scope("round_attack_transform"):
+                deltas = apply_upload_attack(
+                    deltas, byz, keys, attack, attack_scale, attack_eps,
+                    participation=n_ex > 0,
+                )
         return deltas
 
     def _mean_delta(out, n_ex, params=None, wire=None, trust=None):
@@ -2161,10 +2165,14 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                     apply_upload_attack,
                 )
 
-                stacked = apply_upload_attack(
-                    stacked, jnp.asarray(byz), keys, attack, attack_scale,
-                    attack_eps, participation=jnp.asarray(n_ex) > 0,
-                )
+                # same scope name as the sharded engine's _wire_stack —
+                # the cost-model phase taxonomy (obs/roofline.py) is
+                # engine-invariant down to the device-trace labels
+                with jax.named_scope("round_attack_transform"):
+                    stacked = apply_upload_attack(
+                        stacked, jnp.asarray(byz), keys, attack, attack_scale,
+                        attack_eps, participation=jnp.asarray(n_ex) > 0,
+                    )
             if fused_reduce is not None and aggregator in (
                 "weighted_mean", "krum",
             ):

@@ -45,6 +45,12 @@ from colearn_federated_learning_tpu.obs import (
     round_host_input_bytes,
     round_shape_stats,
 )
+from colearn_federated_learning_tpu.obs.roofline import (
+    PEAK_HBM_BYTES_PER_SEC,
+    analytic_step_flops,
+    mfu_basis,
+    round_phase_costs,
+)
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
 from colearn_federated_learning_tpu.parallel.round_engine import (
     make_async_round_fn,
@@ -598,16 +604,35 @@ class Experiment:
         # Round-lifecycle telemetry (run.obs, obs/): the tracer times
         # host phases (and attributes retraces via compile hooks); the
         # health monitor watches the fetched losses at flush
-        # boundaries. Trace export is single-writer like the JSONL.
+        # boundaries. Under multi-process EVERY process traces into its
+        # own lane (pid = process_index): non-primaries export per-host
+        # `trace.p<i>.json` fragments and the primary merges them into
+        # the final trace.json — the merged timeline replaces the old
+        # process-0-only export. The JSONL stays single-writer.
         obs = cfg.run.obs
+        self._process_index = jax.process_index()
         self.tracer = Tracer(
-            enabled=obs.spans, trace=obs.trace and self._primary,
+            enabled=obs.spans, trace=obs.trace,
             max_events=obs.trace_max_events,
+            process_index=self._process_index,
         )
         self.health = (
             HealthMonitor(obs.divergence_factor) if obs.health else None
         )
         self._counters_on = obs.counters
+        # analytic per-phase FLOP/HBM-byte cost records (obs/roofline):
+        # pure function of config + realized grid, so both engines (and
+        # the fused path) log identical numbers — parity-pinned like
+        # the wire counters. Rides the counters infrastructure.
+        # Centralized synchronous rounds only: the gossip/fedbuff round
+        # programs have different phase structure and would be
+        # mis-modeled by the cohort-upload taxonomy.
+        self._phase_cost_on = (
+            obs.counters and obs.phase_cost
+            and not (self.gossip or self.fedbuff)
+        )
+        self._phase_costs: Dict[int, Dict[str, Dict[str, int]]] = {}
+        self._step_flops_cache = None
 
         # Host-side round-input construction: the C++ threaded pipeline
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
@@ -738,6 +763,104 @@ class Experiment:
 
     def _param_bytes(self) -> int:
         return self._param_stats()[1]
+
+    # ------------------------------------------------------------------
+    # analytic phase-cost model (obs/roofline.py)
+
+    def _compute_itemsize(self) -> int:
+        """Bytes per element at the EFFECTIVE compute precision — the
+        same bf16-if-either-dtype-is-bf16 rule as the MFU basis."""
+        basis, _ = mfu_basis(
+            self.cfg.run.compute_dtype, self.cfg.run.local_param_dtype,
+            self.cfg.run.param_dtype,
+        )
+        return 2 if basis == "bf16_peak" else 4
+
+    def _xla_step_flops(self) -> Optional[int]:
+        """XLA-counted FLOPs of one scan-free train step (fwd+bwd on one
+        batch) — the bench's ``model_tflops_per_round`` machinery, but
+        lowered from eval_shape structs so no params are materialized.
+        None when the backend exposes no cost model."""
+        from colearn_federated_learning_tpu.client.trainer import (
+            make_loss_fn,
+            normalize_input,
+        )
+
+        bs = self.cfg.client.batch_size
+        try:
+            dummy = jax.ShapeDtypeStruct(
+                (1,) + self.fed.train_x.shape[1:], self.fed.train_x.dtype
+            )
+            p_shapes = jax.eval_shape(
+                lambda d: self.model.init(
+                    jax.random.PRNGKey(0), normalize_input(d), train=False
+                )["params"],
+                dummy,
+            )
+            x_s = jax.ShapeDtypeStruct(
+                (bs,) + self.fed.train_x.shape[1:], self.fed.train_x.dtype
+            )
+            y_s = jax.ShapeDtypeStruct(
+                (bs,) + self.fed.train_y.shape[1:], self.fed.train_y.dtype
+            )
+            m_s = jax.ShapeDtypeStruct((bs,), jnp.float32)
+            step = jax.value_and_grad(make_loss_fn(self.model, self.task))
+            compiled = jax.jit(step).lower(p_shapes, x_s, y_s, m_s).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            if not ca or "flops" not in ca:
+                return None
+            return int(ca["flops"])
+        except Exception:
+            return None
+
+    def _train_step_flops(self) -> tuple:
+        """(flops, source) of ONE train step on one batch, cached for
+        the run. ``run.obs.phase_cost_flops`` picks the source: the
+        dense 6·P·B analytic approximation (default, zero compiles) or
+        XLA's cost model (exact, one extra compile; falls back to
+        analytic when the backend has no cost model)."""
+        if self._step_flops_cache is None:
+            coords, _ = self._param_stats()
+            bs = self.cfg.client.batch_size
+            x = self.fed.train_x
+            # token corpora: the matmul unit is a token, not an example
+            units = bs * (
+                int(x.shape[1])
+                if x.ndim == 2 and np.issubdtype(x.dtype, np.integer)
+                else 1
+            )
+            flops, source = None, "analytic"
+            if self.cfg.run.obs.phase_cost_flops == "xla":
+                flops = self._xla_step_flops()
+                if flops is not None:
+                    source = "xla"
+            if flops is None:
+                flops = analytic_step_flops(coords, units)
+            self._step_flops_cache = (int(flops), source)
+        return self._step_flops_cache
+
+    def _record_phase_cost(self, round_idx: int, k: int, steps: int,
+                           batch: int, host_input_bytes: int) -> None:
+        """Analytic per-phase FLOP/byte costs for one round on its
+        REALIZED (bucketed) grid — a pure function of the config and
+        the grid, so the sharded, sequential, and fused engines record
+        identical numbers (parity-pinned in tests/test_roofline.py).
+        Drained into `phase_cost` JSONL records at flush boundaries."""
+        cfg = self.cfg
+        step_flops, _ = self._train_step_flops()
+        coords, _ = self._param_stats()
+        self._phase_costs[round_idx] = round_phase_costs(
+            k=k, steps=steps, batch=batch, n_coords=coords,
+            compute_bytes=self._compute_itemsize(), step_flops=step_flops,
+            aggregator=cfg.server.aggregator,
+            attack=bool(self._attack_upload),
+            ledger=bool(self._ledger_on),
+            reputation=bool(cfg.server.reputation.enabled),
+            fused_apply=bool(cfg.server.fused_apply),
+            host_input_bytes=int(host_input_bytes),
+        )
 
     def _check_memory_budget(self) -> None:
         """Construction-time HBM pre-flight (VERDICT r4 missing-#4):
@@ -1376,6 +1499,11 @@ class Experiment:
                 if self._bucket_ladder is not None:
                     stats["shape_bucket_steps"] = steps_g
             self._comm_stats[round_idx] = stats
+            if self._phase_cost_on:
+                self._record_phase_cost(
+                    round_idx, rows, steps_g, batch_g,
+                    stats["host_input_bytes"],
+                )
         if not place:
             # fuse>1 requires hbm placement (validate), so slab is None
             return cohort, idx, mask, n_ex, self.train_x, self.train_y, n_host
@@ -2113,13 +2241,34 @@ class Experiment:
                 print(f"run_summary log failed: {e}", flush=True)
             if self.tracer.trace and self.cfg.run.out_dir:
                 # end-of-fit Chrome-trace dump (aborted/failed runs
-                # included — the trace is the post-mortem artifact)
+                # included — the trace is the post-mortem artifact).
+                # Multi-process: non-primaries write per-host
+                # `trace.p<i>.json` fragments; the primary merges every
+                # fragment present into the final trace.json so the
+                # timeline carries one lane group per host (fragments
+                # from hosts that finish later stay loadable on their
+                # own — the merge is best-effort by design).
                 try:
-                    path = self.tracer.export(
-                        os.path.join(self._run_dir(), "trace.json")
-                    )
-                    if path:
-                        self.logger.log({"event": "trace", "path": path})
+                    if self._primary:
+                        import glob as _glob
+
+                        frags = sorted(_glob.glob(
+                            os.path.join(self._run_dir(), "trace.p*.json")
+                        ))
+                        path = self.tracer.export(
+                            os.path.join(self._run_dir(), "trace.json"),
+                            fragments=frags,
+                        )
+                        if path:
+                            self.logger.log({
+                                "event": "trace", "path": path,
+                                "merged_fragments": len(frags),
+                            })
+                    else:
+                        self.tracer.export(os.path.join(
+                            self._run_dir(),
+                            f"trace.p{self._process_index}.json",
+                        ))
                 except Exception as e:
                     print(f"trace export failed: {e}", flush=True)
             # flush + join the TensorBoard writer thread (no-op without TB)
@@ -2186,6 +2335,31 @@ class Experiment:
                 ),
                 "fused_apply": bool(cfg.server.fused_apply),
                 "double_buffer": bool(self._double_buffer),
+            })
+        if start_round == 0 and self._phase_cost_on:
+            # the static half of the cost model (obs/roofline.py): the
+            # per-round `phase_cost` records carry only the per-grid
+            # numbers; `colearn mfu` joins the two. peak_flops follows
+            # the run's mfu_basis so a bf16 run is never decomposed
+            # against the f32 roof (or vice versa).
+            step_flops, flop_source = self._train_step_flops()
+            coords, p_bytes = self._param_stats()
+            basis, peak = mfu_basis(
+                cfg.run.compute_dtype, cfg.run.local_param_dtype,
+                cfg.run.param_dtype,
+            )
+            self.logger.log({
+                "event": "phase_cost_model",
+                "step_flops": int(step_flops),
+                "flop_source": flop_source,
+                "n_coords": int(coords),
+                "param_bytes": int(p_bytes),
+                "compute_bytes": int(self._compute_itemsize()),
+                "mfu_basis": basis,
+                "peak_flops": float(peak),
+                "peak_hbm_bytes_per_sec": float(PEAK_HBM_BYTES_PER_SEC),
+                "n_chips": int(self.n_chips),
+                "process_index": int(self._process_index),
             })
         if start_round == 0 and self._poisson:
             self.logger.log({
@@ -2280,6 +2454,7 @@ class Experiment:
                     self._total_compile_ms += comp["total_ms"]
                 self.logger.log({
                     "event": "spans", "round": last_round, "phases": phases,
+                    "process_index": int(self._process_index),
                 })
             if obs_cfg.device_memory:
                 mem = device_memory_stats()
@@ -2361,6 +2536,16 @@ class Experiment:
                     if k in record:
                         self._run_totals[k] += int(record[k])
                 self.logger.log(record)
+                pc = self._phase_costs.pop(ridx, None)
+                if pc is not None:
+                    # the analytic cost record rides next to the round
+                    # it describes — `colearn mfu` joins these with the
+                    # spans records into the waterfall
+                    self.logger.log({
+                        "event": "phase_cost", "round": ridx + 1,
+                        "process_index": int(self._process_index),
+                        "phases": pc,
+                    })
             last_round = pending[-1][0] + 1
             self._rounds_done = max(self._rounds_done, last_round)
             pending.clear()

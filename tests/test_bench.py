@@ -6,6 +6,7 @@ perfetto-trace parser (host/device track disambiguation) and the pure
 gating rule, including that a simulated 2× device-time regression trips
 the gate under ANY wall-clock reading."""
 
+import glob
 import gzip
 import json
 import os
@@ -118,3 +119,135 @@ def test_mfu_basis_tracks_compute_dtype():
     f32 = get_named_config("mnist_fedavg_2")
     basis, peak = bench._mfu_basis(f32)
     assert basis == "f32_peak" and peak == bench.PEAK_F32_FLOPS
+
+
+# ---------------------------------------------------------------------------
+# bench regression observatory (r8): `colearn bench-report` trajectory
+# + per-phase budget gates over BENCH_r*.json (obs/roofline.py)
+# ---------------------------------------------------------------------------
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE_HISTORY = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "bench_history"
+)
+
+
+def test_peaks_are_single_sourced_from_roofline():
+    """bench.py re-exports the roofline peaks — a drifted local copy
+    would make `colearn mfu`'s waterfall stop summing to the bench's
+    headline MFU."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    assert bench.PEAK_BF16_FLOPS is roofline.PEAK_BF16_FLOPS
+    assert bench.PEAK_F32_FLOPS is roofline.PEAK_F32_FLOPS
+
+
+def test_load_bench_history_tolerates_pre_mfu_entries():
+    """The r01 fixture mirrors the real first bench record, which
+    predates every post-PR-7 extra (mfu_basis, compute_dtype,
+    phase_ms, device_ms): loading and rendering must produce n/a
+    fields, never a KeyError."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    entries = roofline.load_bench_history(_FIXTURE_HISTORY)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["value"] == 3.0479 and e["n"] == 1
+    for missing in ("mfu_pct", "mfu_basis", "compute_dtype",
+                    "phase_ms_per_round", "device_ms_per_round"):
+        assert e[missing] is None
+    report = roofline.bench_report(entries, {"rounds_per_sec_min": 2.0})
+    text = roofline.format_bench_report(report, _FIXTURE_HISTORY)
+    assert "n/a" in text and report["violations"] == []
+
+
+def test_bench_report_cli_passes_on_real_history(capsys):
+    """The repo's own BENCH_r01..r05 trajectory must pass the
+    checked-in BENCH_BUDGETS.json — keeps the committed baseline
+    honest (a budget nobody can meet would make every CI run red)."""
+    from colearn_federated_learning_tpu import cli
+
+    assert os.path.isfile(os.path.join(_ROOT, "BENCH_BUDGETS.json"))
+    assert cli.main(["bench-report", "--dir", _ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r05.json" in out and "PASS" in out
+
+
+def _seed_history(tmp_path, phase_ms=None, value=3.42, n=6):
+    """Copy the repo history into tmp and append a synthetic newest
+    entry (optionally carrying phase_ms extras)."""
+    import shutil
+
+    for src in sorted(glob.glob(os.path.join(_ROOT, "BENCH_r0*.json"))):
+        shutil.copy(src, tmp_path / os.path.basename(src))
+    extra = {"timed_rounds": 16, "mfu_pct": 41.0}
+    if phase_ms is not None:
+        extra["phase_ms"] = phase_ms
+    entry = {"n": n, "rc": 0,
+             "parsed": {"value": value, "vs_baseline": value / 2.22,
+                        "extra": extra}}
+    with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as f:
+        json.dump(entry, f)
+
+
+def test_bench_report_scalar_floor_gate_trips(tmp_path, capsys):
+    from colearn_federated_learning_tpu import cli
+
+    _seed_history(tmp_path, value=1.0)  # collapse vs the 3.0 floor
+    with open(tmp_path / "BENCH_BUDGETS.json", "w") as f:
+        json.dump({"rounds_per_sec_min": 3.0}, f)
+    assert cli.main(["bench-report", "--dir", str(tmp_path)]) == 1
+    assert "rounds_per_sec" in capsys.readouterr().out
+
+
+def test_bench_report_phase_regression_names_the_phase(tmp_path, capsys):
+    """The tier-1 observatory smoke (ISSUE 8 satellite): inject a
+    synthetic per-phase regression into a copied bench history and the
+    gate must exit non-zero NAMING the offending phase — the plateau
+    is localized the moment it appears."""
+    from colearn_federated_learning_tpu import cli
+
+    _seed_history(tmp_path, n=6, phase_ms={
+        "round.dispatch": 1600.0, "round.host_inputs": 160.0,
+    })
+    # newest entry: dispatch blown 2× per round, host_inputs healthy
+    _seed_history(tmp_path, n=7, phase_ms={
+        "round.dispatch": 3200.0, "round.host_inputs": 150.0,
+    })
+    with open(tmp_path / "BENCH_BUDGETS.json", "w") as f:
+        json.dump({"rounds_per_sec_min": 3.0,
+                   "phase_regression_factor": 1.25}, f)
+    assert cli.main(["bench-report", "--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "round.dispatch" in out and "GATE FAILURES" in out
+    # the healthy phase is not blamed
+    assert not any("round.host_inputs" in line
+                   for line in out.splitlines() if "exceeds" in line)
+
+
+def test_bench_report_first_phase_appearance_pins_not_gates(tmp_path):
+    """A phase's FIRST measured appearance has no best-so-far and no
+    explicit budget: it becomes the pin, it cannot fail the gate (the
+    r01-r05 history has no phase_ms at all — the first TPU run that
+    records phases must go green)."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    _seed_history(tmp_path, phase_ms={"round.dispatch": 9999.0})
+    entries = roofline.load_bench_history(str(tmp_path))
+    report = roofline.bench_report(
+        entries, {"rounds_per_sec_min": 3.0,
+                  "phase_regression_factor": 1.25},
+    )
+    assert report["violations"] == []
+
+
+def test_bench_report_explicit_phase_budget_overrides_best(tmp_path):
+    from colearn_federated_learning_tpu.obs import roofline
+
+    _seed_history(tmp_path, phase_ms={"round.dispatch": 1600.0})
+    entries = roofline.load_bench_history(str(tmp_path))
+    report = roofline.bench_report(entries, {
+        "phase_budget_ms": {"round.dispatch": 50.0},  # 1600/16 = 100 > 50
+    })
+    assert any("round.dispatch" in v and "explicit" in v
+               for v in report["violations"])

@@ -497,3 +497,111 @@ def test_summarize_surfaces_precision_line(tmp_path):
     text = format_summary(summary)
     assert "precision: compute=bfloat16  params=float32" in text
     assert "fused_apply" in text and "double_buffer" in text
+
+
+# ---------------------------------------------------------------------------
+# r8 satellites: summarize's run_summary fast path + multi-process
+# trace lanes / fragment merge + process_index tagging
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_consumes_run_summary_totals():
+    """When the log carries the end-of-fit run_summary record, the
+    totals come from IT (the authoritative every-exit-path record) —
+    not from re-summing per-round counters — and the table says which
+    path produced them."""
+    recs = [
+        {"schema": 1, "round": 1, "train_loss": 1.0, "examples": 8.0,
+         "upload_bytes": 100, "upload_bytes_raw": 100,
+         "download_bytes": 50, "download_bytes_raw": 50},
+        # a torn/partial final window: the per-round records only saw
+        # round 1, but the run_summary knows the real totals
+        {"schema": 1, "event": "run_summary", "rounds": 3,
+         "wall_time_sec": 2.5, "compiles": 7, "compile_ms": 120.0,
+         "upload_bytes": 300, "upload_bytes_raw": 300,
+         "download_bytes": 150, "download_bytes_raw": 150},
+    ]
+    summary = summarize_records(recs)
+    assert summary["source"] == "run_summary"
+    assert summary["rounds"] == 3
+    assert summary["comm"]["upload_bytes"] == 300  # NOT the re-sum (100)
+    assert summary["wall_time_sec"] == 2.5 and summary["compiles"] == 7
+    text = format_summary(summary)
+    assert "totals: run_summary record" in text
+
+
+def test_summarize_falls_back_for_pre_run_summary_logs():
+    recs = [
+        {"schema": 1, "round": 1, "train_loss": 1.0, "examples": 8.0,
+         "upload_bytes": 100, "upload_bytes_raw": 100,
+         "download_bytes": 50, "download_bytes_raw": 50},
+        {"schema": 1, "round": 2, "train_loss": 0.9, "examples": 8.0,
+         "upload_bytes": 100, "upload_bytes_raw": 100,
+         "download_bytes": 50, "download_bytes_raw": 50},
+    ]
+    summary = summarize_records(recs)
+    assert summary["source"] == "reaggregated"
+    assert summary["comm"]["upload_bytes"] == 200  # the per-round re-sum
+    assert "re-aggregated" in format_summary(summary)
+
+
+def test_tracer_pid_is_the_process_index():
+    clock = iter(float(t) for t in range(100))
+    tr = Tracer(trace=True, clock=lambda: next(clock), process_index=3)
+    with tr.span("round"):
+        pass
+    assert all(e["pid"] == 3 for e in tr._events)
+
+
+def test_trace_export_merges_per_host_fragments(tmp_path):
+    """Multi-process runs: non-primary hosts export trace.p<i>.json
+    fragments and the primary merges them into one timeline — one lane
+    group (pid) per host, instead of silently reflecting process 0."""
+    clock1 = iter(float(t) for t in range(100))
+    worker = Tracer(trace=True, clock=lambda: next(clock1),
+                    process_index=1)
+    with worker.span("round.dispatch"):
+        pass
+    frag = str(tmp_path / "trace.p1.json")
+    assert worker.export(frag) == frag
+    json.load(open(frag))  # the fragment is loadable on its own
+
+    clock0 = iter(float(t) for t in range(100))
+    primary = Tracer(trace=True, clock=lambda: next(clock0),
+                     process_index=0)
+    with primary.span("round"):
+        pass
+    merged = str(tmp_path / "trace.json")
+    primary.export(merged, fragments=[frag,
+                                      str(tmp_path / "missing.json")])
+    doc = json.load(open(merged))
+    events = doc["traceEvents"]
+    pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    # one process_name metadata lane per host, labelled by host index
+    lanes = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert set(lanes) == {0, 1} and "host 1" in lanes[1]
+
+
+def test_spans_and_phase_cost_records_carry_process_index(tmp_path):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": 2, "server.eval_every": 0,
+        "server.checkpoint_every": 0,
+        "data.num_clients": 4, "server.cohort_size": 2,
+        "data.synthetic_train_size": 64, "data.synthetic_test_size": 32,
+        "data.max_examples_per_client": 16, "client.batch_size": 8,
+        "run.out_dir": str(tmp_path),
+    })
+    cfg.validate()
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    Experiment(cfg, echo=False).fit()
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    recs = load_records(path)
+    tagged = [r for r in recs
+              if r.get("event") in ("spans", "phase_cost",
+                                    "phase_cost_model")]
+    assert tagged, "expected spans + phase_cost records"
+    assert all(r.get("process_index") == 0 for r in tagged)
