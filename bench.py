@@ -241,6 +241,22 @@ def _gate(name: str, rounds_per_sec: float, device_ms, mfu_pct):
     return (rounds_per_sec / baseline if baseline else 1.0), "rounds_per_sec"
 
 
+def _peak_host_rss_mb():
+    """Peak resident set size of THIS process (ru_maxrss; KiB on
+    Linux). Recorded in every result's extra so the BENCH trajectory
+    carries the clients-scale axis next to rounds/sec — the ROADMAP
+    item-1 acceptance (`store_scale_1m` flat vs `store_scale_1k`) is
+    read directly off these numbers. Matrix mode runs one subprocess
+    per config, so each peak is that config's own."""
+    import resource
+    import sys
+
+    kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    if sys.platform == "darwin":  # ru_maxrss is bytes on macOS
+        kb /= 1024.0
+    return round(kb / 1024.0, 1)
+
+
 def _hbm_stats():
     """Peak/in-use device memory if the backend exposes it (HBM headroom
     for the north-star scale record); None otherwise."""
@@ -354,6 +370,12 @@ def bench_config(name: str):
         "timed_rounds": timed,
         "platform": jax.devices()[0].platform,
         "data_source": exp.fed.meta.get("source"),
+        # clients-scale axis (ROADMAP item 1): every result records the
+        # federation size and this process's peak host RSS, so the
+        # BENCH trajectory shows host memory tracking O(cohort), not
+        # O(num_clients), as the store-backed entries scale up
+        "num_clients": cfg.data.num_clients,
+        "peak_host_rss_mb": _peak_host_rss_mb(),
         "final_train_loss": round(last_loss, 4),
         "param_dtype": cfg.run.param_dtype,
         # precision provenance (r7, ROADMAP item 2): which dtype the
@@ -459,15 +481,105 @@ def bench_config(name: str):
     }
 
 
+# Clients-scale entries (ROADMAP item 1 acceptance): the same tiny
+# store-backed workload at 10³ and 10⁶ clients — streaming sampler,
+# stream placement, mmap store — so the BENCH trajectory records host
+# RSS staying flat (within 1.5×) while num_clients grows 1000×. Built
+# on the fly into a temp dir (a 10⁶-client store of 2×(12,12,1)-uint8
+# records is ~290 MB of DISK, a few MB of touched pages).
+_STORE_SCALE = {
+    "store_scale_1k": 1_000,
+    "store_scale_1m": 1_000_000,
+}
+
+
+def bench_store_scale(name: str):
+    import shutil
+    import tempfile
+
+    import jax
+
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.data.store import (
+        build_synthetic_store,
+    )
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    n = _STORE_SCALE[name]
+    warmup, timed = 2, 6
+    tmp = tempfile.mkdtemp(prefix=f"bench_{name}_")
+    try:
+        t_build0 = time.perf_counter()
+        build_synthetic_store(
+            tmp, num_clients=n, examples_per_client=2, shape=(12, 12, 1),
+            num_classes=10, seed=0, test_examples=64,
+        )
+        build_sec = time.perf_counter() - t_build0
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.apply_overrides({
+            "data.num_clients": n, "data.store.dir": tmp,
+            "data.placement": "stream", "server.sampling": "streaming",
+            "server.cohort_size": 16, "client.batch_size": 2,
+            "server.num_rounds": warmup + timed, "server.eval_every": 0,
+            "server.checkpoint_every": 0, "run.out_dir": "",
+        })
+        cfg.validate()
+        exp = Experiment(cfg, echo=False)
+        state = exp._place_state(exp.init_state())
+        for r in range(warmup):
+            state = exp.run_round(state, r)
+            state.pop("_metrics")
+        t0 = time.perf_counter()
+        pending = []
+        for r in range(warmup, warmup + timed):
+            state = exp.run_round(state, r)
+            pending.append(state.pop("_metrics"))
+        fetched = jax.device_get(pending)
+        dt = time.perf_counter() - t0
+        rss = _peak_host_rss_mb()
+        return {
+            "metric": (
+                f"FL rounds/sec ({n}-client mmap store, lenet5, "
+                f"cohort {cfg.server.cohort_size}, streaming sampler)"
+            ),
+            "value": round(timed / dt, 4),
+            "unit": "rounds/sec",
+            "vs_baseline": 1.0,
+            "extra": {
+                "num_clients": n,
+                "peak_host_rss_mb": rss,
+                "store_backed": True,
+                "store_build_sec": round(build_sec, 2),
+                "placement": "stream",
+                "sampler": "streaming",
+                "platform": jax.devices()[0].platform,
+                "timed_rounds": timed,
+                "final_train_loss": round(
+                    float(fetched[-1].train_loss), 4
+                ),
+                # the acceptance readout: compare this config's
+                # peak_host_rss_mb against store_scale_1k's in the same
+                # BENCH_r*.json — flat (≤1.5×) across the 1000× scale
+                # step is ROADMAP item 1's bar
+                "rss_budget_vs_1k": 1.5,
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default="cifar10_fedavg_100",
-                    choices=sorted(_SHAPES))
+                    choices=sorted(_SHAPES) + sorted(_STORE_SCALE))
     ap.add_argument("--matrix", action="store_true",
                     help="bench every config; one JSON line each")
     args = ap.parse_args(argv)
     if not args.matrix:
-        print(json.dumps(bench_config(args.config)), flush=True)
+        if args.config in _STORE_SCALE:
+            print(json.dumps(bench_store_scale(args.config)), flush=True)
+        else:
+            print(json.dumps(bench_config(args.config)), flush=True)
         return
     # Matrix mode re-execs one subprocess per config: each gets a clean
     # process (allocator stats aren't cumulative across configs, no
@@ -475,7 +587,7 @@ def main(argv=None):
     import subprocess
     import sys
 
-    for name in sorted(_SHAPES):
+    for name in sorted(_SHAPES) + sorted(_STORE_SCALE):
         proc = subprocess.run(
             [sys.executable, __file__, "--config", name],
             capture_output=True, text=True,

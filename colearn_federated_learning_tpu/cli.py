@@ -100,6 +100,50 @@ def build_parser():
 
     sub.add_parser("configs", help="list named configs")
 
+    st = sub.add_parser(
+        "store",
+        help="on-disk mmap client store (data/store.py): build one from "
+             "a config's data (or stream a synthetic federation at any "
+             "client count), or inspect an existing store",
+    )
+    st_sub = st.add_subparsers(dest="store_cmd", required=True)
+    sb = st_sub.add_parser(
+        "build",
+        help="write fixed-record binary shards + per-client index; "
+             "point data.store.dir at the result to run store-backed",
+    )
+    sb.add_argument("--out", required=True, metavar="DIR",
+                    help="store directory to create")
+    sb.add_argument("--config", default=None,
+                    help="convert this config's data (synthetic/LEAF/"
+                         "real + partition, exactly what the in-memory "
+                         "run would see — store-backed runs are then "
+                         "bitwise-equal to it)")
+    sb.add_argument("--set", action="append", metavar="KEY=VALUE",
+                    dest="overrides", help="dotted config override")
+    sb.add_argument("--synthetic-clients", type=int, default=None,
+                    metavar="N",
+                    help="instead of --config: stream a deterministic "
+                         "synthetic federation of N clients straight to "
+                         "shards (never materializes the corpus — the "
+                         "million-client path)")
+    sb.add_argument("--leaf-femnist", default=None, metavar="DATA_DIR",
+                    help="instead of --config: stream DATA_DIR/femnist "
+                         "LEAF json files to shards, one writer per "
+                         "client, one file resident at a time")
+    sb.add_argument("--examples-per-client", type=int, default=2)
+    sb.add_argument("--shape", default="12,12,1",
+                    help="synthetic example shape, comma-separated "
+                         "(default 12,12,1)")
+    sb.add_argument("--classes", type=int, default=10)
+    sb.add_argument("--seed", type=int, default=0)
+    sb.add_argument("--test-examples", type=int, default=64)
+    sb.add_argument("--shard-mb", type=int, default=64,
+                    help="approximate shard file size; shards only "
+                         "split between clients")
+    si = st_sub.add_parser("info", help="describe an existing store")
+    si.add_argument("dir", metavar="DIR")
+
     sm = sub.add_parser(
         "summarize",
         help="aggregate a run's metrics JSONL into a per-phase "
@@ -185,6 +229,66 @@ def main(argv=None):
     if args.cmd == "configs":
         for name in list_named_configs():
             print(name)
+        return 0
+
+    if args.cmd == "store":
+        from colearn_federated_learning_tpu.data import store as store_mod
+
+        if args.store_cmd == "info":
+            try:
+                print(json.dumps(store_mod.open_store(args.dir).describe()))
+            except (FileNotFoundError, ValueError) as e:
+                print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+                return 2
+            return 0
+        # build: exactly one source
+        sources = [args.config, args.synthetic_clients, args.leaf_femnist]
+        if sum(s is not None for s in sources) != 1:
+            print("error: store build needs exactly one of --config, "
+                  "--synthetic-clients, or --leaf-femnist",
+                  file=sys.stderr)
+            return 2
+        try:
+            if args.leaf_femnist is not None:
+                out = store_mod.write_femnist_store(
+                    args.leaf_femnist, args.out, seed=args.seed,
+                    shard_mb=args.shard_mb,
+                )
+            elif args.config is not None:
+                cfg = resolve_config(
+                    args.config, _parse_overrides(args.overrides)
+                )
+                if cfg.data.store.dir:
+                    raise ValueError(
+                        "the source config already points at a store "
+                        "(data.store.dir) — converting a store into a "
+                        "store is a no-op; use the original config"
+                    )
+                from colearn_federated_learning_tpu.data import (
+                    build_federated_data,
+                )
+
+                fed = build_federated_data(
+                    cfg.data, seed=cfg.run.seed, **cfg.model.kwargs
+                )
+                out = store_mod.write_store(
+                    args.out, fed, shard_mb=args.shard_mb
+                )
+            else:
+                out = store_mod.build_synthetic_store(
+                    args.out,
+                    num_clients=args.synthetic_clients,
+                    examples_per_client=args.examples_per_client,
+                    shape=[int(s) for s in args.shape.split(",")],
+                    num_classes=args.classes,
+                    seed=args.seed,
+                    test_examples=args.test_examples,
+                    shard_mb=args.shard_mb,
+                )
+        except (KeyError, ValueError, FileNotFoundError) as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        print(json.dumps(store_mod.open_store(out).describe()))
         return 0
 
     if args.cmd == "bench-report":

@@ -28,6 +28,36 @@ class ModelConfig:
 
 
 @dataclass
+class StoreConfig:
+    """On-disk memory-mapped client store (``data.store``, data/store.py
+    — ROADMAP item 1, the million-client data path). With ``dir`` set,
+    the training corpus comes from fixed-record binary shards plus a
+    small per-client offset/length index built by ``colearn store
+    build``: example bytes stay on disk behind ``np.memmap`` views, the
+    per-client partition IS the store's index (``data.partition`` /
+    synthetic knobs are ignored — they were baked in at build time),
+    and the host pipeline gathers only the sampled cohort's records
+    into each round's slab. Pair with ``data.placement="stream"`` for
+    the O(cohort) host-RAM path (``"hbm"`` still works — the whole
+    store is materialized to device once, for small stores / big
+    chips). Store-backed runs are BITWISE-equal to the in-memory run
+    the store was converted from, on the same seed (test-pinned across
+    engines and fuse_rounds). ``data.num_clients`` must match the
+    store's client count (checked with a clear error). Rejected
+    pairings: ``attack.kind="label_flip"`` (poisons labels host-side;
+    the store is a read-only mmap) and ``run.host_pipeline="native"``
+    (the C++ pipeline materializes the per-client index lists;
+    ``"auto"`` degrades to NumPy)."""
+
+    # store directory ("" = off, classic in-memory data path)
+    dir: str = ""
+    # load the whole store into plain host arrays and run the classic
+    # in-memory path — the "in-memory twin" for store↔in-memory parity
+    # checks; only sensible for stores that fit in RAM
+    materialize: bool = False
+
+
+@dataclass
 class DataConfig:
     name: str = "mnist"
     num_clients: int = 2
@@ -69,6 +99,8 @@ class DataConfig:
     #            than HBM (e.g. real ImageNet at 224px) at the cost of a
     #            per-round host→device transfer.
     placement: str = "hbm"  # hbm | stream
+    # On-disk mmap client store — see StoreConfig.
+    store: StoreConfig = field(default_factory=StoreConfig)
 
 
 @dataclass
@@ -177,6 +209,14 @@ class AdaptiveSamplerConfig:
     # exponential suppression of high-flag-rate clients in the draw
     # probabilities (the selection-side twin of reputation weighting)
     flag_suppress: float = 4.0
+    # sampling="streaming" only: max rows in the compact adaptive-score
+    # sketch (the columnar {id, count, flagged, ema_loss} table the
+    # streaming draw scores from). When more clients than this have
+    # ledger evidence, the highest-participation rows are kept; clients
+    # outside the sketch draw from the closed-form optimistic unseen
+    # pool. Bounds the sampler's host memory and checkpoint footprint
+    # regardless of num_clients.
+    sketch_size: int = 4096
 
 
 @dataclass
@@ -287,7 +327,20 @@ class ServerConfig:
     #              floor, flag-rate suppression — see
     #              AdaptiveSamplerConfig / `server.adaptive`). Requires
     #              run.obs.client_ledger.enabled with log_every >= 1.
-    sampling: str = "uniform"  # uniform | weighted | poisson | adaptive
+    #   streaming — the million-client mode: draws a fixed-size cohort
+    #              in O(cohort·log) without ever enumerating the client
+    #              universe (no dense [num_clients] probability vector,
+    #              no O(N) permutation). Uniform rejection draw until
+    #              ledger evidence arrives; with the client ledger on
+    #              (log_every >= 1) it scores the SAME Oort-style
+    #              formula as "adaptive" over a compact fixed-size
+    #              sketch of observed clients plus a closed-form
+    #              optimistic unseen pool (server.adaptive.sketch_size
+    #              caps the sketch). Schedules are deterministic in
+    #              (seed, round, sketch) and resume-replayable, but are
+    #              a DIFFERENT deterministic sequence than "uniform"/
+    #              "adaptive" produce (different draw algorithm).
+    sampling: str = "uniform"  # uniform | weighted | poisson | adaptive | streaming
     # Simulated client dropout: fraction of the sampled cohort whose
     # update is zeroed inside the round function (total failure).
     dropout_rate: float = 0.0
@@ -503,6 +556,24 @@ class ClientLedgerConfig:
     # rounds between periodic client_ledger JSONL snapshots (emitted at
     # metrics-flush boundaries); 0 = only the end-of-fit/abort record
     log_every: int = 0
+    # Paged ledger (obs/ledger.py LedgerPager — the million-client
+    # mode): 0 keeps the classic dense [num_clients, LEDGER_WIDTH]
+    # device store; > 0 keeps only a [hot_capacity, LEDGER_WIDTH]
+    # LRU-style HOT set device-resident, scattered by SLOT (the driver
+    # remaps cohort ids to slots host-side; the round program is
+    # unchanged), with cold rows spilled to an anonymous host mmap.
+    # Page-ins ride a tiny async device scatter; an eviction needs one
+    # blocking hot-set fetch (counted as ledger_page_syncs in
+    # run_summary). Reputation/adaptive selection read exactly the same
+    # rows they would from the dense ledger, so paging is invisible to
+    # the round program for any cohort that fits the hot set — the
+    # merged (hot ∪ cold) ledger is bitwise-equal to the dense run's
+    # (test-pinned), and flush/resume behave exactly like today. Must
+    # be >= cohort_size × fuse_rounds (checked at construction);
+    # values >= num_clients degrade to the dense store. Incompatible
+    # with server.error_feedback (the EF store is indexed by true
+    # client ids on the same cohort input the pager remaps).
+    hot_capacity: int = 0
 
 
 @dataclass
@@ -929,7 +1000,7 @@ class ExperimentConfig:
         if self.run.engine not in ("sharded", "sequential"):
             raise ValueError(f"unknown engine {self.run.engine!r}")
         if self.server.sampling not in (
-            "uniform", "weighted", "poisson", "adaptive"
+            "uniform", "weighted", "poisson", "adaptive", "streaming"
         ):
             raise ValueError(f"unknown server.sampling {self.server.sampling!r}")
         if (self.server.sampling == "poisson"
@@ -1197,11 +1268,11 @@ class ExperimentConfig:
                     "secure_aggregation (per-round key-protocol host "
                     "I/O cannot ride the fused scan)"
                 )
-            if self.data.placement != "hbm":
-                raise ValueError(
-                    "fuse_rounds > 1 requires data.placement=hbm "
-                    "(stream slabs are built per round)"
-                )
+            # data.placement="stream" composes since the client-store PR:
+            # the fused chunk gathers ONE union slab over its sub-rounds'
+            # cohorts (static rows = fuse × slab rows) and remaps the
+            # stacked index tensors into it — the engine still sees a
+            # single corpus input per dispatch.
             if self.server.num_rounds % f:
                 raise ValueError(
                     f"fuse_rounds={f} must divide num_rounds="
@@ -1448,6 +1519,21 @@ class ExperimentConfig:
                 f"run.obs.client_ledger.log_every must be >= 0, "
                 f"got {cl.log_every}"
             )
+        if cl.hot_capacity < 0:
+            raise ValueError(
+                f"run.obs.client_ledger.hot_capacity must be >= 0, "
+                f"got {cl.hot_capacity}"
+            )
+        if cl.enabled and cl.hot_capacity > 0 and self.server.error_feedback:
+            # the EF residual store is indexed by TRUE client ids and
+            # shares the engines' cohort-id input with the ledger — the
+            # pager's slot remap would scatter residuals to wrong rows
+            raise ValueError(
+                "run.obs.client_ledger.hot_capacity > 0 is incompatible "
+                "with server.error_feedback (the EF store is indexed by "
+                "true client ids on the same cohort-id input the paged "
+                "ledger remaps to hot-set slots)"
+            )
         if cl.enabled:
             if self.server.secure_aggregation:
                 # the ledger computes per-client upload statistics —
@@ -1507,7 +1593,7 @@ class ExperimentConfig:
                 "applies its pairing exclusions — secagg, client-level "
                 "DP, gossip/fedbuff, stateful algorithms)"
             )
-        if self.server.sampling == "adaptive":
+        if self.server.sampling in ("adaptive", "streaming"):
             ad = self.server.adaptive
             if not 0.0 < ad.explore <= 1.0:
                 raise ValueError(
@@ -1524,6 +1610,58 @@ class ExperimentConfig:
                     f"server.adaptive.flag_suppress must be >= 0, "
                     f"got {ad.flag_suppress}"
                 )
+            if ad.sketch_size < 1:
+                raise ValueError(
+                    f"server.adaptive.sketch_size must be >= 1, "
+                    f"got {ad.sketch_size}"
+                )
+        if self.server.sampling == "streaming" and cl.enabled and cl.log_every >= 1:
+            # ledger evidence flows into the streaming sketch at the
+            # same snapshot-refresh boundaries as "adaptive" — the same
+            # schedule-purity constraints apply (the cohort must be a
+            # pure function of (seed, round, sketch) so prefetch/resume
+            # replay it; the prefetch worker drains itself at refresh
+            # boundaries, which is why placement=stream IS allowed here)
+            if self.run.fuse_rounds > 1 and cl.log_every % self.run.fuse_rounds:
+                raise ValueError(
+                    f"server.sampling='streaming' with run.fuse_rounds="
+                    f"{self.run.fuse_rounds} requires client_ledger."
+                    f"log_every ({cl.log_every}) to be a fuse_rounds "
+                    f"multiple (sketch refreshes must land on fused-"
+                    f"chunk boundaries)"
+                )
+            if self.run.shape_buckets.enabled:
+                raise ValueError(
+                    "server.sampling='streaming' with ledger-fed "
+                    "sketches is incompatible with run.shape_buckets "
+                    "(the bucket rung must be a pure function of "
+                    "(seed, round); sketch-scored cohorts depend on "
+                    "the ledger snapshot)"
+                )
+            if self.run.host_pipeline == "native":
+                raise ValueError(
+                    "server.sampling='streaming' with ledger-fed "
+                    "sketches is incompatible with run.host_pipeline="
+                    "'native' (the C++ pipeline prefetches future "
+                    "cohorts ahead of sketch refreshes); use 'auto' or "
+                    "'numpy'"
+                )
+        st = self.data.store
+        if st.dir:
+            if self.attack.kind == "label_flip":
+                raise ValueError(
+                    "attack.kind='label_flip' is incompatible with "
+                    "data.store (label poisoning mutates training labels "
+                    "host-side; the store's records are a read-only mmap)"
+                )
+            if self.run.host_pipeline == "native":
+                raise ValueError(
+                    "data.store is incompatible with run.host_pipeline="
+                    "'native' (the C++ pipeline materializes the full "
+                    "per-client index lists the store exists to avoid); "
+                    "use 'auto' or 'numpy'"
+                )
+        if self.server.sampling == "adaptive":
             if not cl.enabled or cl.log_every < 1:
                 # the sampler's scores refresh from the periodic ledger
                 # snapshots; without a cadence they would stay frozen at
@@ -1612,6 +1750,7 @@ class ExperimentConfig:
             "client_ledger": ClientLedgerConfig,  # nested under run.obs
             "reputation": ReputationConfig,  # nested under server
             "adaptive": AdaptiveSamplerConfig,  # nested under server
+            "store": StoreConfig,  # nested under data
         }
         return build(cls, d)
 

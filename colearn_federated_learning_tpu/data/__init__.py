@@ -4,11 +4,19 @@ Dataset registry + federated partitioners + the static-shape round-batch
 index builder. The design splits "bytes" from "structure": example
 arrays live once in HBM (device-resident), while per-round client
 batches are tiny int32 index tensors generated on host — the host never
-touches example data inside the round loop.
+touches example data inside the round loop. At million-client scale the
+bytes move to an on-disk mmap client store (`data/store.py`,
+``data.store.dir``) and only the sampled cohort's records ever become
+host-resident.
 """
 
 from colearn_federated_learning_tpu.data.core import (  # noqa: F401
     FederatedData,
     build_federated_data,
     dataset_registry,
+)
+from colearn_federated_learning_tpu.data.store import (  # noqa: F401
+    build_synthetic_store,
+    open_store,
+    write_store,
 )

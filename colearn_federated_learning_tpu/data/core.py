@@ -56,6 +56,12 @@ class FederatedData:
         return len(self.client_indices)
 
     def client_sizes(self) -> np.ndarray:
+        # store-backed federations (data/store.py ClientIndexView) carry
+        # the per-client counts directly — the O(num_clients)-aranges
+        # loop below would materialize what the lazy view exists to avoid
+        sizes = getattr(self.client_indices, "sizes", None)
+        if sizes is not None:
+            return np.asarray(sizes, np.int64)
         return np.array([len(ix) for ix in self.client_indices], np.int64)
 
 
@@ -338,7 +344,21 @@ def _load_shakespeare(cfg: DataConfig, vocab_size: int = 90, seq_len: int = 80, 
 
 
 def build_federated_data(cfg: DataConfig, seed: int = 0, **model_kwargs) -> FederatedData:
-    """Load a dataset and partition it into ``cfg.num_clients`` shards."""
+    """Load a dataset and partition it into ``cfg.num_clients`` shards.
+
+    With ``cfg.store.dir`` set the corpus comes from an on-disk client
+    store instead (data/store.py): example bytes stay memory-mapped, the
+    partition IS the store's per-client index (loader/partition config
+    fields are ignored — they were baked in at ``colearn store build``
+    time), and only the sampled cohort's records ever touch host RAM.
+    """
+    if cfg.store.dir:
+        from colearn_federated_learning_tpu.data.store import open_store
+
+        return open_store(cfg.store.dir).as_federated_data(
+            expected_clients=cfg.num_clients,
+            materialize=cfg.store.materialize,
+        )
     loader = dataset_registry.get(cfg.name)
     tx, ty, ex, ey, meta, num_classes, task = loader(cfg, **model_kwargs)
     labels_for_partition = ty if task == "classify" else ty[:, 0]

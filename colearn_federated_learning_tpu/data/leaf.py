@@ -19,6 +19,40 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 
+def iter_leaf_clients(path: str):
+    """Stream ``(user, user_data)`` one ``*.json`` file at a time — the
+    store-builder seam (data/store.py ``write_femnist_store``): host
+    memory is O(largest file), never O(directory), which is what lets a
+    LEAF corpus convert to an on-disk client store at scales where
+    :func:`load_leaf_json_dir`'s merged dict would not fit in RAM.
+    Files are visited in sorted order and users in file order — the
+    exact stream :func:`load_leaf_json_dir` merges, so converters that
+    consume rng draws per user stay bit-compatible with the in-memory
+    loaders. A user appearing in MORE than one file is rejected:
+    ``load_leaf_json_dir`` silently keeps the last occurrence, but a
+    streaming writer has already shipped the first one's records."""
+    seen: set = set()
+    any_file = False
+    for fname in sorted(os.listdir(path)):
+        if not fname.endswith(".json"):
+            continue
+        any_file = True
+        with open(os.path.join(path, fname)) as f:
+            blob = json.load(f)
+        for u in blob["users"]:
+            if u in seen:
+                raise ValueError(
+                    f"LEAF user {u!r} appears in multiple json files "
+                    f"under {path} — the streaming store conversion "
+                    f"cannot merge split users; re-export the data with "
+                    f"one file per user set"
+                )
+            seen.add(u)
+            yield u, blob["user_data"][u]
+    if not any_file:
+        raise FileNotFoundError(f"no LEAF json files under {path}")
+
+
 def load_leaf_json_dir(path: str) -> Tuple[Dict[str, dict], List[str]]:
     """Read every ``*.json`` in a LEAF data dir and merge user_data."""
     user_data: Dict[str, dict] = {}

@@ -148,6 +148,18 @@ def make_round_spec(
     draws depend only on the cohort's shard lengths and the cap —
     never on the grid shape — so a bucketed (smaller-``steps``) grid
     packs the *same* example order as the full grid.
+
+    LOAD-BEARING for the client store (data/store.py): selection and
+    ordering happen by POSITION within each shard (argsort over keys
+    that depend only on lengths), and the shard's index *values* only
+    flow through as opaque gather targets. A store-backed federation
+    renumbers global example ids (client-contiguous) but maps every
+    (client, position) to the same example bytes — so store-backed
+    runs pack byte-identical examples into identical grid slots and
+    stay BITWISE-equal to the in-memory runs they were converted from
+    (tests/test_store.py pins this across engines and fuse_rounds).
+    Any future change that makes draws or ordering depend on index
+    VALUES breaks that contract.
     """
     k = len(cohort_ids)
     steps, batch = shape.steps, shape.batch_size

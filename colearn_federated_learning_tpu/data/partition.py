@@ -21,6 +21,15 @@ import numpy as np
 
 
 def iid_partition(n: int, num_clients: int, seed: int) -> List[np.ndarray]:
+    if num_clients > n:
+        # array_split would silently hand back empty shards that only
+        # surface rounds later as an opaque eval/np.repeat error — name
+        # both numbers at partition time instead
+        raise ValueError(
+            f"iid_partition: {num_clients} clients over {n} examples "
+            f"would leave {num_clients - n} client shard(s) empty — "
+            f"reduce data.num_clients or provide more examples"
+        )
     rng = np.random.default_rng(seed)
     perm = rng.permutation(n)
     return [np.sort(s) for s in np.array_split(perm, num_clients)]

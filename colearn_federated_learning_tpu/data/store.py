@@ -1,0 +1,507 @@
+"""On-disk memory-mapped client store (`data.store`, ROADMAP item 1).
+
+The in-memory data path tops out around 10³ clients: `build_federated_data`
+materializes the whole corpus in host RAM plus a Python list of
+per-client index arrays. This module is the million-client replacement —
+LEAF-style fixed-record binary shards on disk (Caldas et al., LEAF) with
+a small per-client offset/length index, from which the host pipeline
+assembles round slabs by mmap gather: only the sampled cohort's example
+records ever become resident; every host-side structure the round loop
+touches is O(cohort), not O(num_clients).
+
+Layout of a store directory::
+
+    meta.json            # schema: record shapes/dtypes, counts, task
+    index.npy            # int64 [num_clients] per-client example counts
+    shard_00000.x.bin    # fixed-record example bytes, client-contiguous
+    shard_00000.y.bin    # fixed-record label/target bytes, same order
+    ...
+    test.npz             # the held-out eval split (bounded; loaded to RAM)
+
+Invariants the round-path parity contract rests on:
+
+- **Client-contiguous global ids.** Client ``c``'s examples occupy the
+  global id range ``[starts[c], starts[c] + counts[c])``, in the exact
+  order the source's ``client_indices[c]`` listed them. The index
+  builder (`data/loader.make_round_spec`) draws by *position within the
+  shard* (its randomness depends only on shard lengths and the cap), so
+  a store-backed run gathers byte-identical examples into the identical
+  grid slots as the in-memory run it was converted from — store-backed
+  ≡ in-memory **bitwise** on the same seed (pinned by tests/test_store.py).
+- **Clients never span shards.** A shard holds whole clients, so a
+  cohort gather touches at most ``O(cohort)`` shard ranges.
+- **Fixed records.** Every example's x (and y) serializes to the same
+  byte count, so ``record i`` of a shard lives at byte offset
+  ``i * record_nbytes`` — the offset/length index stays two ints per
+  client.
+
+Two builders feed the format:
+
+- :func:`write_store` *converts* an existing in-memory
+  :class:`~colearn_federated_learning_tpu.data.core.FederatedData`
+  (synthetic, LEAF, real files — whatever `build_federated_data`
+  produced, partition included) into shards, one client at a time.
+- :func:`build_synthetic_store` *streams* a deterministic synthetic
+  federation straight to disk in client chunks — the only way to build
+  a 10⁶-client store without ever materializing a 10⁶-client corpus.
+
+``colearn store build`` (cli.py) fronts both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+STORE_VERSION = 1
+_META = "meta.json"
+_INDEX = "index.npy"
+_TEST = "test.npz"
+
+
+def _shard_name(i: int, kind: str) -> str:
+    return f"shard_{i:05d}.{kind}.bin"
+
+
+# ---------------------------------------------------------------------------
+# the mmap-backed record array
+# ---------------------------------------------------------------------------
+
+
+class ShardedRecordArray:
+    """A read-only array view over fixed-record binary shard files.
+
+    Quacks enough like an ``np.ndarray`` for every way the round path
+    touches the training corpus — ``.shape``/``.dtype``/``.nbytes``/
+    ``len()``, integer/slice/fancy indexing (the slab gather), and
+    ``__array__`` (full materialization, for ``data.placement="hbm"``
+    and the ``materialize`` twin) — while keeping example bytes on disk:
+    a gather reads only the touched records through per-shard
+    ``np.memmap`` views, so host residency is O(gathered rows), not
+    O(corpus).
+    """
+
+    def __init__(self, paths: Sequence[str], shard_counts: Sequence[int],
+                 rec_shape: Sequence[int], dtype) -> None:
+        self._paths = list(paths)
+        self._bounds = np.concatenate(
+            [[0], np.cumsum(np.asarray(shard_counts, np.int64))]
+        )
+        self._rec_shape = tuple(int(s) for s in rec_shape)
+        self.dtype = np.dtype(dtype)
+        self.shape = (int(self._bounds[-1]),) + self._rec_shape
+        self._maps: List[Optional[np.memmap]] = [None] * len(self._paths)
+
+    # ---- ndarray-protocol surface -----------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def itemsize(self) -> int:
+        return self.dtype.itemsize
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * self.itemsize
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def _map(self, s: int) -> np.memmap:
+        m = self._maps[s]
+        if m is None:
+            n = int(self._bounds[s + 1] - self._bounds[s])
+            m = np.memmap(self._paths[s], dtype=self.dtype, mode="r",
+                          shape=(n,) + self._rec_shape)
+            self._maps[s] = m
+        return m
+
+    def gather(self, ids) -> np.ndarray:
+        """Copy the records at global ``ids`` (any order, duplicates ok)
+        into a fresh array — the O(rows) slab-gather primitive."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size and (ids.min() < 0 or ids.max() >= len(self)):
+            raise IndexError(
+                f"store gather ids out of range [0, {len(self)})"
+            )
+        out = np.empty((len(ids),) + self._rec_shape, self.dtype)
+        shard = np.searchsorted(self._bounds, ids, side="right") - 1
+        for s in np.unique(shard):
+            sel = shard == s
+            out[sel] = self._map(int(s))[ids[sel] - self._bounds[s]]
+        return out
+
+    def __getitem__(self, key):
+        if isinstance(key, (int, np.integer)):
+            return self.gather(np.asarray([key]))[0]
+        if isinstance(key, slice):
+            return self.gather(np.arange(*key.indices(len(self))))
+        key = np.asarray(key)
+        if key.dtype == bool:
+            key = np.flatnonzero(key)
+        return self.gather(key)
+
+    def __array__(self, dtype=None, copy=None):
+        # full materialization — only the hbm-placement / materialize
+        # paths reach this; the streaming round loop never does
+        out = self.gather(np.arange(len(self)))
+        return out if dtype is None else out.astype(dtype)
+
+
+class ClientIndexView:
+    """Lazy stand-in for the ``client_indices`` list: client ``c``'s
+    shard is the contiguous global-id range ``arange(starts[c],
+    starts[c] + counts[c])``, built on demand — the host never holds
+    O(num_clients) index arrays (a 10⁶-entry list of aranges is itself
+    a hundred-MB structure). ``sizes`` is the O(num_clients)-ints
+    fast path ``FederatedData.client_sizes`` consumes directly."""
+
+    def __init__(self, counts: np.ndarray) -> None:
+        self.sizes = np.asarray(counts, np.int64)
+        self.starts = np.concatenate([[0], np.cumsum(self.sizes)])
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, c):
+        if not isinstance(c, (int, np.integer)):
+            raise TypeError(
+                f"client index must be an int, got {type(c).__name__}"
+            )
+        c = int(c)
+        if not 0 <= c < len(self.sizes):
+            raise IndexError(f"client {c} out of range [0, {len(self.sizes)})")
+        return np.arange(self.starts[c], self.starts[c + 1], dtype=np.int64)
+
+    def __iter__(self):
+        for c in range(len(self.sizes)):
+            yield self[c]
+
+
+# ---------------------------------------------------------------------------
+# writing
+# ---------------------------------------------------------------------------
+
+
+class _ShardWriter:
+    """Rolls ``shard_*.{x,y}.bin`` files at ~``shard_mb`` boundaries,
+    only ever splitting BETWEEN clients (the clients-never-span-shards
+    invariant)."""
+
+    def __init__(self, out_dir: str, shard_mb: float) -> None:
+        self.out_dir = out_dir
+        self.budget = max(1, int(shard_mb * 2**20))
+        self.shard_counts: List[int] = []
+        self._fx = self._fy = None
+        self._bytes = 0
+
+    def _roll(self) -> None:
+        self.close_shard()
+        i = len(self.shard_counts)
+        self._fx = open(os.path.join(self.out_dir, _shard_name(i, "x")), "wb")
+        self._fy = open(os.path.join(self.out_dir, _shard_name(i, "y")), "wb")
+        self.shard_counts.append(0)
+        self._bytes = 0
+
+    def close_shard(self) -> None:
+        for f in (self._fx, self._fy):
+            if f is not None:
+                f.close()
+        self._fx = self._fy = None
+
+    def write_clients(self, x: np.ndarray, y: np.ndarray) -> None:
+        """Append one or more whole clients' records (already ordered)."""
+        if self._fx is None or (
+            self._bytes and self._bytes + x.nbytes > self.budget
+        ):
+            self._roll()
+        self._fx.write(np.ascontiguousarray(x).tobytes())
+        self._fy.write(np.ascontiguousarray(y).tobytes())
+        self.shard_counts[-1] += len(x)
+        self._bytes += x.nbytes + y.nbytes
+
+
+def _write_meta(out_dir: str, *, counts: np.ndarray, shard_counts: List[int],
+                x_shape, x_dtype, y_shape, y_dtype, num_classes: int,
+                task: str, source: str, test_examples: int,
+                extra: Optional[Dict[str, Any]] = None) -> None:
+    meta = {
+        "version": STORE_VERSION,
+        "num_clients": int(len(counts)),
+        "num_examples": int(counts.sum()),
+        "num_classes": int(num_classes),
+        "task": task,
+        "source": source,
+        "x_shape": [int(s) for s in x_shape],
+        "x_dtype": np.dtype(x_dtype).name,
+        "y_shape": [int(s) for s in y_shape],
+        "y_dtype": np.dtype(y_dtype).name,
+        "shard_examples": [int(c) for c in shard_counts],
+        "test_examples": int(test_examples),
+        **(extra or {}),
+    }
+    np.save(os.path.join(out_dir, _INDEX), np.asarray(counts, np.int64))
+    # atomic finalize: a store with meta.json is a complete store
+    tmp = os.path.join(out_dir, _META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(out_dir, _META))
+
+
+def write_store(out_dir: str, fed, shard_mb: float = 64) -> str:
+    """Convert an in-memory :class:`FederatedData` into a client store.
+
+    Clients are written in id order, each client's examples in its
+    ``client_indices[c]`` order — the renumbering that makes global ids
+    client-contiguous while keeping every (client, position) → example
+    mapping identical to the source. One client is materialized at a
+    time, so peak memory is O(largest shard), not O(corpus)."""
+    os.makedirs(out_dir, exist_ok=True)
+    if len(fed.train_y) and fed.train_y.ndim == 1:
+        y_shape: tuple = ()
+    else:
+        y_shape = fed.train_y.shape[1:]
+    writer = _ShardWriter(out_dir, shard_mb)
+    counts = fed.client_sizes()
+    for c in range(fed.num_clients):
+        ids = np.asarray(fed.client_indices[c])
+        writer.write_clients(fed.train_x[ids], fed.train_y[ids])
+    writer.close_shard()
+    np.savez(os.path.join(out_dir, _TEST), x=fed.test_x, y=fed.test_y)
+    _write_meta(
+        out_dir, counts=counts, shard_counts=writer.shard_counts,
+        x_shape=fed.train_x.shape[1:], x_dtype=fed.train_x.dtype,
+        y_shape=y_shape, y_dtype=fed.train_y.dtype,
+        num_classes=fed.num_classes, task=fed.task,
+        source=f"store({fed.meta.get('source', 'unknown')})",
+        test_examples=len(fed.test_x),
+    )
+    return out_dir
+
+
+# clients generated per rng draw in build_synthetic_store — a FIXED
+# internal constant (not a knob): the draw stream is consumed chunk by
+# chunk, so the chunk size is part of what `seed` determines
+_GEN_CHUNK_CLIENTS = 4096
+
+
+def build_synthetic_store(
+    out_dir: str,
+    num_clients: int,
+    examples_per_client: int = 2,
+    shape: Sequence[int] = (12, 12, 1),
+    num_classes: int = 10,
+    seed: int = 0,
+    template_weight: float = 0.7,
+    test_examples: int = 64,
+    shard_mb: float = 64,
+) -> str:
+    """Stream a deterministic synthetic federation straight to shards.
+
+    The class-template image family from data/core.py (learnable, so
+    scale smokes converge meaningfully), generated a fixed
+    ``_GEN_CHUNK_CLIENTS`` clients at a time and written through the
+    shard writer — peak host memory is one chunk regardless of
+    ``num_clients``. Deterministic in ``seed`` alone (the generation
+    chunking is a fixed constant and the shard roll never touches the
+    rng, so ``shard_mb`` cannot change a byte)."""
+    from colearn_federated_learning_tpu.data.core import _synthetic_images
+
+    if num_clients < 1 or examples_per_client < 1:
+        raise ValueError(
+            f"need num_clients >= 1 and examples_per_client >= 1, got "
+            f"{num_clients} / {examples_per_client}"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng((int(seed), 0x570_4E))
+    shape = tuple(int(s) for s in shape)
+    templates = rng.uniform(0.0, 1.0, size=(num_classes,) + shape).astype(
+        np.float32
+    )
+    writer = _ShardWriter(out_dir, shard_mb)
+    done = 0
+    while done < num_clients:
+        k = min(_GEN_CHUNK_CLIENTS, num_clients - done)
+        x, y = _synthetic_images(
+            rng, k * examples_per_client, templates, template_weight
+        )
+        writer.write_clients(x, y)
+        done += k
+    writer.close_shard()
+    ex, ey = _synthetic_images(rng, test_examples, templates, template_weight)
+    np.savez(os.path.join(out_dir, _TEST), x=ex, y=ey)
+    counts = np.full(num_clients, examples_per_client, np.int64)
+    _write_meta(
+        out_dir, counts=counts, shard_counts=writer.shard_counts,
+        x_shape=shape, x_dtype=np.uint8, y_shape=(), y_dtype=np.int32,
+        num_classes=num_classes, task="classify", source="store(synthetic)",
+        test_examples=test_examples,
+        extra={"seed": int(seed), "template_weight": float(template_weight)},
+    )
+    return out_dir
+
+
+def write_femnist_store(data_dir: str, out_dir: str,
+                        test_fraction: float = 0.1, seed: int = 0,
+                        shard_mb: float = 64) -> str:
+    """Stream a LEAF FEMNIST json dir straight to a client store — one
+    writer per client, one json FILE resident at a time
+    (``data/leaf.iter_leaf_clients``). The in-memory path
+    (``load_femnist`` → ``write_store``) holds the whole merged corpus
+    in RAM first; this converter's footprint is O(largest file). The
+    per-writer held-out split consumes the rng exactly like
+    ``load_femnist`` (same seed, same user stream ⇒ the same examples
+    land in train/test), and each client's train records are written in
+    the identical permuted order — pinned by tests/test_store.py."""
+    from colearn_federated_learning_tpu.data.leaf import iter_leaf_clients
+
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    writer = _ShardWriter(out_dir, shard_mb)
+    counts: List[int] = []
+    test_xs: List[np.ndarray] = []
+    test_ys: List[np.ndarray] = []
+    for _u, ud in iter_leaf_clients(os.path.join(data_dir, "femnist")):
+        x = np.asarray(ud["x"], np.float32).reshape(-1, 28, 28, 1)
+        y = np.asarray(ud["y"], np.int32)
+        n_test = max(1, int(len(x) * test_fraction)) if len(x) > 1 else 0
+        perm = rng.permutation(len(x))
+        test_ix, train_ix = perm[:n_test], perm[n_test:]
+        writer.write_clients(x[train_ix], y[train_ix])
+        counts.append(len(train_ix))
+        test_xs.append(x[test_ix])
+        test_ys.append(y[test_ix])
+    writer.close_shard()
+    np.savez(os.path.join(out_dir, _TEST),
+             x=np.concatenate(test_xs), y=np.concatenate(test_ys))
+    _write_meta(
+        out_dir, counts=np.asarray(counts, np.int64),
+        shard_counts=writer.shard_counts,
+        x_shape=(28, 28, 1), x_dtype=np.float32,
+        y_shape=(), y_dtype=np.int32,
+        num_classes=62, task="classify", source="store(leaf_femnist)",
+        test_examples=int(sum(len(t) for t in test_xs)),
+    )
+    return out_dir
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+
+class ClientStore:
+    """An opened store directory: the per-client index (host-resident,
+    two ints per client), mmap record arrays for x/y, and the bounded
+    eval split (loaded to RAM — it is shared, not per-client)."""
+
+    def __init__(self, store_dir: str) -> None:
+        self.dir = os.path.abspath(os.path.expanduser(store_dir))
+        meta_path = os.path.join(self.dir, _META)
+        try:
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"no client store at {self.dir!r} (missing {_META}; build "
+                f"one with `colearn store build`)"
+            ) from None
+        if self.meta.get("version") != STORE_VERSION:
+            raise ValueError(
+                f"store {self.dir!r} has version {self.meta.get('version')}, "
+                f"this build reads version {STORE_VERSION}"
+            )
+        self.counts = np.load(os.path.join(self.dir, _INDEX))
+        shard_counts = self.meta["shard_examples"]
+        if int(self.counts.sum()) != int(sum(shard_counts)):
+            raise ValueError(
+                f"store {self.dir!r} is corrupt: index covers "
+                f"{int(self.counts.sum())} examples, shards hold "
+                f"{int(sum(shard_counts))}"
+            )
+
+        def arr(kind: str, shape_key: str, dtype_key: str):
+            return ShardedRecordArray(
+                [os.path.join(self.dir, _shard_name(i, kind))
+                 for i in range(len(shard_counts))],
+                shard_counts,
+                self.meta[shape_key], self.meta[dtype_key],
+            )
+
+        self.x = arr("x", "x_shape", "x_dtype")
+        self.y = arr("y", "y_shape", "y_dtype")
+        with np.load(os.path.join(self.dir, _TEST)) as t:
+            self.test_x = t["x"]
+            self.test_y = t["y"]
+
+    @property
+    def num_clients(self) -> int:
+        return int(len(self.counts))
+
+    def as_federated_data(self, expected_clients: Optional[int] = None,
+                          materialize: bool = False):
+        """The store as a :class:`FederatedData` the driver consumes.
+
+        Default: train arrays are the mmap views and ``client_indices``
+        the lazy O(1)-per-client view — the streaming round path.
+        ``materialize=True`` loads everything into plain host arrays
+        (the "in-memory twin" the store↔in-memory parity pins run
+        against; only sensible for stores that fit in RAM)."""
+        from colearn_federated_learning_tpu.data.core import FederatedData
+
+        if (expected_clients is not None
+                and expected_clients != self.num_clients):
+            raise ValueError(
+                f"data.num_clients={expected_clients} but the store at "
+                f"{self.dir!r} holds {self.num_clients} clients — set "
+                f"data.num_clients to match the store"
+            )
+        view = ClientIndexView(self.counts)
+        if materialize:
+            train_x: Any = np.asarray(self.x)
+            train_y: Any = np.asarray(self.y)
+            indices: Any = [view[c] for c in range(self.num_clients)]
+        else:
+            train_x, train_y, indices = self.x, self.y, view
+        meta = {
+            "source": self.meta.get("source", "store"),
+            "store_dir": self.dir,
+            "store_materialized": bool(materialize),
+            "input_shape": tuple(self.meta["x_shape"]),
+        }
+        return FederatedData(
+            train_x=train_x, train_y=train_y,
+            test_x=self.test_x, test_y=self.test_y,
+            client_indices=indices,
+            num_classes=int(self.meta["num_classes"]),
+            task=self.meta.get("task", "classify"),
+            meta=meta,
+        )
+
+    def describe(self) -> Dict[str, Any]:
+        """`colearn store info`'s payload: schema + size facts."""
+        data_bytes = self.x.nbytes + self.y.nbytes
+        return {
+            "dir": self.dir,
+            "num_clients": self.num_clients,
+            "num_examples": int(self.counts.sum()),
+            "examples_per_client_min": int(self.counts.min()),
+            "examples_per_client_max": int(self.counts.max()),
+            "num_classes": int(self.meta["num_classes"]),
+            "task": self.meta.get("task"),
+            "source": self.meta.get("source"),
+            "x_shape": list(self.meta["x_shape"]),
+            "x_dtype": self.meta["x_dtype"],
+            "num_shards": len(self.meta["shard_examples"]),
+            "data_mb": round(data_bytes / 2**20, 2),
+            "test_examples": int(self.meta.get("test_examples", 0)),
+        }
+
+
+def open_store(store_dir: str) -> ClientStore:
+    return ClientStore(store_dir)

@@ -38,7 +38,11 @@ The jax-dependent functions import jax lazily so the CLI report path
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 # per-round stats block columns ([K, NSTATS], float32; flag is 0/1)
 STAT_COLS = ("l2", "cos", "resid", "loss", "z", "flag")
@@ -190,6 +194,164 @@ def update_ledger(ledger, cohort_ids, n_ex, stats, ema: float):
         axis=1,
     )
     return ledger.at[ids].set(new_rows, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# paged ledger (run.obs.client_ledger.hot_capacity) — the million-client
+# mode: a [hot_capacity, LEDGER_WIDTH] device-resident HOT set scattered
+# by slot, cold rows spilled to a host mmap
+# ---------------------------------------------------------------------------
+
+
+class LedgerPager:
+    """Hot/cold paging for the per-client ledger.
+
+    The round program is untouched: it still gathers/scatters a
+    ``[rows, LEDGER_WIDTH]`` ledger by a ``[K]`` int32 id input — the
+    driver simply hands it a ``[hot_capacity, ...]`` array and SLOT ids
+    instead of the dense ``[num_clients, ...]`` array and client ids.
+    This class owns the host-side slot bookkeeping:
+
+    - ``slot_clients[s]`` — the client resident in slot ``s`` (−1 free);
+      ``slot_used[s]`` — the last round that touched it (the LRU key).
+      Both ride the checkpoint, so a resumed run's slot assignment
+      replays the straight run's exactly (assignment is a pure function
+      of the cohort sequence + this state).
+    - the COLD store — a ``[num_clients, LEDGER_WIDTH]`` float32
+      ``np.memmap`` over an anonymous temp file (unlinked immediately:
+      the mapping lives, the directory entry doesn't). Host RSS is
+      O(touched pages), never O(num_clients); disk is
+      ``num_clients × 28`` bytes.
+
+    Correctness contract (test-pinned): for any cohort that fits the
+    hot set, a slot row holds exactly the row the dense ledger would —
+    page-in seeds the slot from the client's cold row (zeros if never
+    seen), eviction writes the hot row back first — so stats updates,
+    reputation trust, and adaptive scoring read/write identical values
+    and the MERGED (cold ∪ hot) ledger is bitwise-equal to a dense
+    run's. Evictions need the CURRENT hot values, which costs one
+    blocking device fetch (``fetch_hot``) — counted in ``page_syncs``;
+    page-ins ride an async device scatter and cost nothing.
+    """
+
+    def __init__(self, num_clients: int, hot_capacity: int) -> None:
+        if not 0 < hot_capacity < num_clients:
+            raise ValueError(
+                f"hot_capacity must be in (0, num_clients={num_clients}); "
+                f"got {hot_capacity}"
+            )
+        self.num_clients = int(num_clients)
+        self.hot_capacity = int(hot_capacity)
+        fd, path = tempfile.mkstemp(prefix="colearn_ledger_cold_")
+        os.close(fd)
+        self.cold = np.memmap(path, dtype=np.float32, mode="w+",
+                              shape=(self.num_clients, LEDGER_WIDTH))
+        os.unlink(path)  # anonymous: freed with the last mapping
+        self.slot_clients = np.full(self.hot_capacity, -1, np.int64)
+        self.slot_used = np.full(self.hot_capacity, -1, np.int64)
+        self._client_slot: Dict[int, int] = {}
+        self.evictions = 0
+        self.page_syncs = 0
+
+    # ---- persistence (rides the driver's checkpoint state) -----------
+
+    def load_state(self, slot_clients, slot_used, cold) -> None:
+        self.slot_clients[:] = np.asarray(slot_clients, np.int64)
+        self.slot_used[:] = np.asarray(slot_used, np.int64)
+        self.cold[:] = np.asarray(cold, np.float32)
+        self._client_slot = {
+            int(c): int(s) for s, c in enumerate(self.slot_clients) if c >= 0
+        }
+
+    # ---- paging ------------------------------------------------------
+
+    def write_back(self, hot: np.ndarray) -> None:
+        """Mirror every occupied hot row into the cold store (after
+        this, ``cold`` IS the merged ledger)."""
+        occ = np.flatnonzero(self.slot_clients >= 0)
+        if occ.size:
+            self.cold[self.slot_clients[occ]] = np.asarray(hot)[occ]
+
+    def lookup(self, ids) -> np.ndarray:
+        """Client ids → resident slot ids; pads (id == num_clients) and
+        non-resident clients map to ``hot_capacity`` — out of bounds for
+        the hot array, so take-fill/scatter-drop make them no-ops
+        exactly like the dense path's pad handling."""
+        ids = np.asarray(ids, np.int64)
+        return np.asarray(
+            [self._client_slot.get(int(c), self.hot_capacity) for c in ids],
+            np.int32,
+        )
+
+    def assign(self, cohort_ids, round_idx: int,
+               fetch_hot: Callable[[], np.ndarray],
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Ensure every real client in ``cohort_ids`` is hot-resident.
+
+        Returns ``(slots, new_slots, seed_rows)``: the per-cohort slot
+        ids (pads → hot_capacity), plus the slots that were just paged
+        in and the cold rows to seed them with (the caller scatters
+        those into the device array — async, no sync). Evicting (no
+        free slot) first write-backs the CURRENT hot values via
+        ``fetch_hot`` — the one blocking sync, counted in
+        ``page_syncs``; LRU victims are never members of this cohort.
+        """
+        ids = np.asarray(cohort_ids, np.int64)
+        real = np.unique(ids[(ids >= 0) & (ids < self.num_clients)])
+        missing = [int(c) for c in real if int(c) not in self._client_slot]
+        free = np.flatnonzero(self.slot_clients < 0)
+        if len(missing) > len(free):
+            protected = {
+                self._client_slot[int(c)] for c in real
+                if int(c) in self._client_slot
+            }
+            hot = np.asarray(fetch_hot())
+            self.write_back(hot)
+            self.page_syncs += 1
+            occupied = np.flatnonzero(self.slot_clients >= 0)
+            victims = [s for s in occupied if s not in protected]
+            # oldest first; slot id breaks ties deterministically
+            victims.sort(key=lambda s: (self.slot_used[s], s))
+            for s in victims[: len(missing) - len(free)]:
+                del self._client_slot[int(self.slot_clients[s])]
+                self.slot_clients[s] = -1
+                self.slot_used[s] = -1
+                self.evictions += 1
+            free = np.flatnonzero(self.slot_clients < 0)
+        if len(missing) > len(free):
+            raise RuntimeError(
+                f"paged ledger: cohort needs {len(missing)} page-ins but "
+                f"only {len(free)} hot slots can be freed "
+                f"(hot_capacity={self.hot_capacity}) — the construction-"
+                f"time capacity check should have prevented this"
+            )
+        new_slots = free[: len(missing)].astype(np.int64)
+        for c, s in zip(missing, new_slots):
+            self._client_slot[c] = int(s)
+            self.slot_clients[s] = c
+        seed_rows = np.asarray(self.cold[np.asarray(missing, np.int64)]
+                               if missing else
+                               np.zeros((0, LEDGER_WIDTH), np.float32))
+        for c in real:
+            self.slot_used[self._client_slot[int(c)]] = round_idx
+        return self.lookup(ids), new_slots.astype(np.int32), seed_rows
+
+    # ---- reporting / snapshots ---------------------------------------
+
+    def active_rows(self, hot: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(client ids, rows) of every client with ≥1 participation, in
+        id order, from the merged hot ∪ cold view (write-back included).
+        O(touched cold pages) host residency; the returned block is
+        O(active clients)."""
+        self.write_back(hot)
+        active = np.flatnonzero(self.cold[:, 0] > 0)
+        return active, np.array(self.cold[active])
+
+    def merged(self, hot: np.ndarray) -> np.ndarray:
+        """The dense ``[num_clients, LEDGER_WIDTH]`` merged ledger (a
+        fresh array — parity tests and small-N snapshot paths only)."""
+        self.write_back(hot)
+        return np.array(self.cold)
 
 
 # ---------------------------------------------------------------------------

@@ -133,15 +133,33 @@ class Experiment:
         self._spec_inputs = cfg.algorithm not in ("gossip", "fedbuff")
         # Ledger-driven adaptive selection (server.sampling="adaptive"):
         # the sampler scores clients Oort-style from periodic host-side
-        # ledger snapshots (loss-utility EMA × participation staleness,
-        # exploration floor, flag-rate suppression). The snapshot
-        # refreshes at client_ledger.log_every round boundaries (one
-        # blocking fetch each — see run_round) and rides the checkpoint
-        # (state["ledger_snapshot"]), so the schedule is a pure function
-        # of (seed, round, snapshot) and resume replays it exactly.
+        # ledger snapshots — COLUMN-SLIMMED to the three columns it
+        # scores (sampler.SNAPSHOT_COLS: count, flagged, ema_loss). The
+        # snapshot refreshes at client_ledger.log_every round boundaries
+        # (one blocking fetch each — see run_round) and rides the
+        # checkpoint (state["ledger_snapshot"], [num_clients, 3]), so
+        # the schedule is a pure function of (seed, round, snapshot) and
+        # resume replays it exactly. server.sampling="streaming" is the
+        # million-client sibling: O(cohort·log) draws from a fixed-size
+        # score SKETCH (state["ledger_sketch_*"]) instead of any dense
+        # [num_clients] structure; with the ledger off it degrades to a
+        # uniform streaming draw with no snapshot machinery at all.
         self._adaptive = cfg.server.sampling == "adaptive"
+        self._streaming = cfg.server.sampling == "streaming"
+        lcfg = cfg.run.obs.client_ledger
+        self._ledger_on = lcfg.enabled
+        self._ledger_cfg = lcfg
+        self._snapshot_refresh = self._adaptive or (
+            self._streaming and lcfg.enabled and lcfg.log_every >= 1
+        )
         self._sampler_snapshot: Optional[np.ndarray] = None
         self._sampler_snapshot_round = 0
+        self._sketch_ids = np.full(
+            cfg.server.adaptive.sketch_size, -1, np.int32
+        )
+        self._sketch_stats = np.zeros(
+            (cfg.server.adaptive.sketch_size, 3), np.float32
+        )
         self.sampler = CohortSampler(
             self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
             weights=(
@@ -149,11 +167,13 @@ class Experiment:
             ),
             mode=(
                 "poisson" if cfg.server.sampling == "poisson"
-                else "adaptive" if self._adaptive else "fixed"
+                else "adaptive" if self._adaptive
+                else "streaming" if self._streaming else "fixed"
             ),
             explore=cfg.server.adaptive.explore,
             staleness_gain=cfg.server.adaptive.staleness_gain,
             flag_suppress=cfg.server.adaptive.flag_suppress,
+            sketch_size=cfg.server.adaptive.sketch_size,
         )
         # Poisson sampling: the realized Binomial(N, q) cohort is padded
         # to a STATIC cap of K + 5σ (so XLA never retraces); overflow
@@ -279,9 +299,8 @@ class Experiment:
         # clients` report read it. validate() already rejected the
         # unsound pairings (secagg, client-DP, gossip/fedbuff,
         # stateful algorithms).
-        lcfg = cfg.run.obs.client_ledger
-        self._ledger_on = lcfg.enabled
-        self._ledger_cfg = lcfg
+        # (lcfg/_ledger_on/_ledger_cfg were hoisted above the sampler —
+        # the snapshot-refresh machinery needs them)
         self._ledger_ref = None
         self._ledger_logged_round = -1
         if self.attack_kind:
@@ -482,6 +501,37 @@ class Experiment:
             # lane-rounded, so the worst-case aggregate bound is final
             self._check_secagg_bounds()
 
+        # Paged ledger (run.obs.client_ledger.hot_capacity, obs/ledger
+        # LedgerPager): the device store shrinks to a [hot_capacity,
+        # LEDGER_WIDTH] hot set scattered by SLOT; the driver remaps
+        # cohort ids → slots host-side (the round program is unchanged)
+        # and spills cold rows to an anonymous host mmap. hot_capacity
+        # >= num_clients (or 0) keeps the classic dense store. The
+        # capacity floor uses the LANE-ROUNDED poisson cap and the full
+        # fused-chunk cohort union — the worst case one dispatch can
+        # touch — so "cohort fits the hot set" is a construction-time
+        # guarantee, not a runtime surprise.
+        self._pager = None
+        self._ledger_rows = self.fed.num_clients
+        hot = lcfg.hot_capacity
+        if self._ledger_on and 0 < hot < self.fed.num_clients:
+            need = (self._poisson_cap or cfg.server.cohort_size) * max(
+                1, cfg.run.fuse_rounds
+            )
+            if hot < need:
+                raise ValueError(
+                    f"run.obs.client_ledger.hot_capacity={hot} is smaller "
+                    f"than the worst-case dispatch cohort "
+                    f"({self._poisson_cap or cfg.server.cohort_size} "
+                    f"clients × fuse_rounds={max(1, cfg.run.fuse_rounds)} "
+                    f"= {need}) — every dispatched cohort must fit the "
+                    f"hot set; raise hot_capacity or shrink the cohort"
+                )
+            from colearn_federated_learning_tpu.obs.ledger import LedgerPager
+
+            self._pager = LedgerPager(self.fed.num_clients, hot)
+            self._ledger_rows = hot
+
         # Training-corpus placement (SURVEY.md §2 C10 at scale):
         #   hbm    — dataset bytes go to HBM exactly once (replicated over
         #            lanes); rounds gather on device. Default.
@@ -525,8 +575,17 @@ class Experiment:
         }
         _warn_bf16_backend(cfg)
         if self._stream:
-            self._slab_rows = min(
-                cfg.server.cohort_size * self.shape.cap + 1,
+            rows_per_round = (
+                (self._poisson_cap or cfg.server.cohort_size)
+                * self.shape.cap + 1
+            )
+            self._slab_rows = min(rows_per_round, len(self.fed.train_x))
+            # fused chunks gather ONE union slab over the chunk's
+            # cohorts (static shape: fuse rounds' worth of rows) and
+            # remap the stacked index tensors into it — the engine
+            # still sees a single corpus input per dispatch
+            self._fused_slab_rows = min(
+                cfg.run.fuse_rounds * (rows_per_round - 1) + 1,
                 len(self.fed.train_x),
             )
             self.train_x = None
@@ -649,13 +708,17 @@ class Experiment:
                 # bucketed grids vary per round; the C++ pipeline builds
                 # ONE fixed shape (validate() rejects the explicit
                 # 'native' pairing; 'auto' degrades to NumPy here).
-                # adaptive sampling: the pipeline prefetches FUTURE
-                # cohorts and treats resubmission as a no-op, so a
-                # ledger-snapshot refresh between prefetch and dispatch
-                # would silently serve a stale cohort's tensors
-                # (validate() rejects explicit 'native'; 'auto' degrades)
+                # snapshot-fed sampling (adaptive, or streaming with a
+                # ledger sketch): the pipeline prefetches FUTURE cohorts
+                # and treats resubmission as a no-op, so a snapshot
+                # refresh between prefetch and dispatch would silently
+                # serve a stale cohort's tensors (validate() rejects
+                # explicit 'native'; 'auto' degrades). Store-backed
+                # federations skip it too: the pipeline materializes the
+                # full per-client index lists the store exists to avoid.
                 and self._bucket_ladder is None
-                and not self._adaptive):
+                and not self._snapshot_refresh
+                and not cfg.data.store.dir):
             from colearn_federated_learning_tpu import native
 
             if native.available():
@@ -982,27 +1045,52 @@ class Experiment:
             )
         if self._ledger_on:
             # per-client forensic ledger rows (count, flagged, EMAs);
-            # row index == client id, no lane padding (the store is
-            # replicated — it is a few KB). Poisson pad slots (id ==
-            # num_clients) scatter out of bounds and drop.
+            # dense: row index == client id; paged: row index == HOT
+            # SLOT (the driver remaps ids — see LedgerPager), with the
+            # cold spill + slot bookkeeping riding alongside. No lane
+            # padding either way (the store is replicated — a few KB).
+            # Pads/non-residents scatter out of bounds and drop.
             from colearn_federated_learning_tpu.obs.ledger import (
                 LEDGER_WIDTH,
             )
 
             state["ledger"] = np.zeros(
-                (self.fed.num_clients, LEDGER_WIDTH), np.float32
+                (self._ledger_rows, LEDGER_WIDTH), np.float32
             )
+            if self._pager is not None:
+                state["ledger_cold"] = np.zeros(
+                    (self.fed.num_clients, LEDGER_WIDTH), np.float32
+                )
+                state["ledger_slots"] = np.full(
+                    self._ledger_rows, -1, np.int64
+                )
+                state["ledger_slot_used"] = np.full(
+                    self._ledger_rows, -1, np.int64
+                )
         if self._adaptive:
             # the adaptive sampler's ACTIVE ledger snapshot (host-side,
             # refreshed at log_every round boundaries) rides the
             # checkpoint so a resumed run scores rounds between
-            # snapshot boundaries exactly like the straight run did
-            from colearn_federated_learning_tpu.obs.ledger import (
-                LEDGER_WIDTH as _LW,
+            # snapshot boundaries exactly like the straight run did.
+            # Column-slimmed (PR 9): only the three scored columns
+            # (sampler.SNAPSHOT_COLS) are fetched and persisted.
+            from colearn_federated_learning_tpu.server.sampler import (
+                SNAPSHOT_COLS,
             )
 
             state["ledger_snapshot"] = np.zeros(
-                (self.fed.num_clients, _LW), np.float32
+                (self.fed.num_clients, len(SNAPSHOT_COLS)), np.float32
+            )
+            state["ledger_snapshot_round"] = 0
+        if self._streaming and self._snapshot_refresh:
+            # the streaming sampler's fixed-size score sketch: columnar
+            # (ids, scored stats) arrays bounded by sketch_size — the
+            # O(1)-in-num_clients replacement for the dense snapshot
+            state["ledger_sketch_ids"] = np.full(
+                len(self._sketch_ids), -1, np.int32
+            )
+            state["ledger_sketch_stats"] = np.zeros(
+                self._sketch_stats.shape, np.float32
             )
             state["ledger_snapshot_round"] = 0
         if self.gossip:
@@ -1099,17 +1187,40 @@ class Experiment:
                     state["c_clients"],
                 )
         if self._ledger_on:
-            # ledger: replicated device array (tiny); a warm-start or
-            # restored ledger arrives as jax/numpy — both place fine
+            # ledger (dense, or the paged HOT set): replicated device
+            # array (tiny); a warm-start or restored ledger arrives as
+            # jax/numpy — both place fine
             state["ledger"] = self._put(
                 jnp.asarray(np.asarray(state["ledger"], np.float32)),
                 self._data_sharding,
             )
+            if self._pager is not None:
+                # cold spill + slot bookkeeping stay HOST-side: load
+                # them into the pager's mmap/maps and re-point the
+                # state at the live structures (so later checkpoints
+                # capture the current paging state without copies)
+                self._pager.load_state(
+                    state["ledger_slots"], state["ledger_slot_used"],
+                    state["ledger_cold"],
+                )
+                state["ledger_cold"] = self._pager.cold
+                state["ledger_slots"] = self._pager.slot_clients
+                state["ledger_slot_used"] = self._pager.slot_used
         if self._adaptive:
             # the sampler snapshot stays HOST-side (the sampler is host
             # code); a restored checkpoint hands back jax arrays
             state["ledger_snapshot"] = np.asarray(
                 state["ledger_snapshot"], np.float32
+            )
+            state["ledger_snapshot_round"] = int(
+                np.asarray(state["ledger_snapshot_round"])
+            )
+        if self._streaming and self._snapshot_refresh:
+            state["ledger_sketch_ids"] = np.asarray(
+                state["ledger_sketch_ids"], np.int32
+            )
+            state["ledger_sketch_stats"] = np.asarray(
+                state["ledger_sketch_stats"], np.float32
             )
             state["ledger_snapshot_round"] = int(
                 np.asarray(state["ledger_snapshot_round"])
@@ -1202,14 +1313,17 @@ class Experiment:
 
         return span()
 
-    def _host_inputs(self, round_idx: int, shape: Optional[RoundShape] = None):
+    def _host_inputs(self, round_idx: int, shape: Optional[RoundShape] = None,
+                     build_slab: bool = True):
         """All host-side work for one round: sampling, index construction,
         dropout weights, and (stream mode) the slab gather. Pure in
         (seed, round) — safe to run ahead on a worker thread.
         ``shape`` overrides the round's grid (the fused chunk-max path);
         default is the round's own bucket rung (or the legacy full
         shape). Under ``_spec_inputs`` the third return slot carries the
-        [K, 2] mask SPEC instead of the full float32 mask."""
+        [K, 2] mask SPEC instead of the full float32 mask.
+        ``build_slab=False`` skips the per-round stream slab — the fused
+        chunk path gathers ONE union slab over the whole chunk instead."""
         if self.gossip and self._gossip_partial == 0:
             # full participation: row i of the round tensors IS client i
             # (the ring order is the client-id order, every round)
@@ -1274,7 +1388,9 @@ class Experiment:
                     [mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)]
                 )
                 n_ex = np.concatenate([n_ex, np.zeros(pad, n_ex.dtype)])
-        slab = self._stream_slab(idx) if self._stream else None
+        slab = (
+            self._stream_slab(idx) if self._stream and build_slab else None
+        )
         return cohort, idx, mask, n_ex, slab
 
     def _apply_failures(self, mask, n_ex, k, host_rng, round_idx=None,
@@ -1384,7 +1500,11 @@ class Experiment:
         the consumer can detect (and drain) a grid mismatch."""
         shape = self._bucket_shape(spe) if spe is not None else None
         cohort, idx, mask, n_ex, slab = self._host_inputs(
-            round_idx, shape=shape
+            round_idx, shape=shape,
+            # fused chunks consume host tensors only (the union slab is
+            # gathered at chunk-stack time); per-round slabs would be
+            # wasted work the consumer drops
+            build_slab=self.cfg.run.fuse_rounds == 1,
         )
         placed = (
             self._place_round_inputs(idx, mask, n_ex, slab) if place
@@ -1430,9 +1550,13 @@ class Experiment:
         for t in range(round_idx + 1, round_idx + 1 + depth):
             if t >= self.cfg.server.num_rounds or t in self._prefetch:
                 continue
-            if self._adaptive:
+            if self._snapshot_refresh:
+                # never prefetch across a snapshot/sketch refresh
+                # boundary — the cohort there is a function of a
+                # snapshot that does not exist yet (adaptive AND
+                # sketch-fed streaming sampling)
                 le = self._ledger_cfg.log_every
-                if t // le != round_idx // le:
+                if le and t // le != round_idx // le:
                     continue
             place = (
                 self._double_buffer and not self._stream and fuse == 1
@@ -1464,11 +1588,16 @@ class Experiment:
         with self.tracer.span("round.host_inputs"):
             if fut is not None:
                 entry = fut.result()
-                if entry["spe"] != want_spe:
+                if entry["spe"] != want_spe or (
+                    place and self._stream and entry["host"][4] is None
+                ):
                     # overlap drain: the prefetched grid was built for a
                     # different ladder rung (unaligned-resume catch-up
                     # dispatches on the round's own rung, not the
-                    # steady-state chunk max) — rebuild on the right one
+                    # steady-state chunk max), or — stream × fuse — it
+                    # was built slab-less for a fused consumer but an
+                    # unfused catch-up round needs the per-round slab.
+                    # Rebuild on the right shape.
                     self._db_stats["prefetch_dropped"] += 1
                     entry = None
                 else:
@@ -1477,7 +1606,7 @@ class Experiment:
                 cohort, idx, mask, n_ex, slab = entry["host"]
             else:
                 cohort, idx, mask, n_ex, slab = self._host_inputs(
-                    round_idx, shape=shape
+                    round_idx, shape=shape, build_slab=place,
                 )
         self._maybe_prefetch(round_idx)
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
@@ -1701,12 +1830,12 @@ class Experiment:
         that land off a chunk boundary (see _fit_body)."""
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
-        if (self._adaptive and round_idx > 0
+        if (self._snapshot_refresh and round_idx > 0
                 and round_idx % self._ledger_cfg.log_every == 0):
-            # snapshot refresh BEFORE this round samples: the cohort for
-            # rounds [r, r + log_every) is a pure function of
+            # snapshot/sketch refresh BEFORE this round samples: the
+            # cohort for rounds [r, r + log_every) is a pure function of
             # (seed, round, ledger@r) — round 0 keeps the all-unseen
-            # uniform prior (the zero snapshot init_state seeds)
+            # uniform prior (the zero snapshot/sketch init_state seeds)
             self._refresh_adaptive_snapshot(round_idx)
         fuse = (
             self.cfg.run.fuse_rounds if fuse_override is None
@@ -1838,7 +1967,9 @@ class Experiment:
             with self.tracer.span("round.secagg_keys"):
                 kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
         if self._ledger_on:
-            cohort_ids = jnp.asarray(np.asarray(cohort, np.int32))
+            cohort_ids = jnp.asarray(
+                self._ledger_slot_ids(cohort, round_idx, state)
+            )
             if self._data_sharding is not None:
                 # sharded: positional trailing (byz, ledger, cohort) so
                 # the ledger input stays donatable
@@ -1925,7 +2056,31 @@ class Experiment:
                 if self._attack_upload:
                     byz_rows.append(byz_h.astype(np.float32))
         with self.tracer.span("round.placement"):
-            idx_f = self._put(np.stack(idxs), self._fused_cohort_sharding)
+            idx_stack = np.stack(idxs)
+            if self._stream:
+                # stream × fuse: ONE union slab over the chunk's cohorts
+                # (static [rows, ...] shape — one trace for the run),
+                # stacked indices remapped into it. The engine still
+                # sees a single corpus input; only the chunk's unique
+                # example records are gathered/uploaded.
+                with self.tracer.span("round.stream_slab"):
+                    uniq, inv = np.unique(idx_stack, return_inverse=True)
+                    rows = self._fused_slab_rows
+                    assert len(uniq) <= rows, (len(uniq), rows)
+                    slab_x = np.empty(
+                        (rows,) + self.fed.train_x.shape[1:],
+                        self.fed.train_x.dtype,
+                    )
+                    slab_y = np.empty(
+                        (rows,) + self.fed.train_y.shape[1:],
+                        self.fed.train_y.dtype,
+                    )
+                    slab_x[: len(uniq)] = self.fed.train_x[uniq]
+                    slab_y[: len(uniq)] = self.fed.train_y[uniq]
+                    idx_stack = inv.reshape(idx_stack.shape).astype(np.int32)
+                train_x = self._put_data(jnp.asarray(slab_x))
+                train_y = self._put_data(jnp.asarray(slab_y))
+            idx_f = self._put(idx_stack, self._fused_cohort_sharding)
             # mask SPECS [F, K, 2] have no batch dim: fuse replicated,
             # cohort over lanes — the per-client fused sharding
             mask_f = self._put(
@@ -1953,9 +2108,20 @@ class Experiment:
                     np.stack(byz_rows), self._fused_client_sharding
                 ),)
             if self.ef or self._ledger_on:
-                cohorts_f = self._put(
-                    np.stack(cohorts), self._data_sharding
-                )
+                if self._pager is not None:
+                    # paged ledger: assign hot slots for the CHUNK'S
+                    # cohort union up front (one assignment protects
+                    # every sub-round's residents from mid-chunk
+                    # eviction), seed paged-in slots, then ship slot
+                    # ids; the engine's gather/scatter is unchanged
+                    union = np.unique(np.concatenate(cohorts))
+                    self._ledger_slot_ids(union, round_idx, state)
+                    cohort_rows = np.stack(
+                        [self._pager.lookup(c) for c in cohorts]
+                    )
+                else:
+                    cohort_rows = np.stack(cohorts)
+                cohorts_f = self._put(cohort_rows, self._data_sharding)
         common = (state["params"], state["server_opt_state"], train_x,
                   train_y, idx_f, mask_f, n_ex_f, rngs_f)
         ledger = None
@@ -2082,6 +2248,30 @@ class Experiment:
                 f"original algorithm/error_feedback settings"
             )
 
+    def _ledger_slot_ids(self, cohort, round_idx: int,
+                         state: Dict[str, Any]) -> np.ndarray:
+        """Ledger row ids for a cohort: the client ids verbatim on the
+        dense store; hot-set SLOT ids under paging (obs/ledger.py
+        LedgerPager). Paging cold members in seeds their slots from the
+        cold mmap via one tiny async device scatter — ``state["ledger"]``
+        is rebound to the seeded array, so the subsequent round dispatch
+        reads client rows identical to the dense run's (the paging-is-
+        invisible contract). Pads (id == num_clients) and anything not
+        resident map out of bounds and drop, exactly like dense pads."""
+        ids = np.asarray(cohort, np.int64)
+        if self._pager is None:
+            return ids.astype(np.int32)
+        slots, new_slots, seed_rows = self._pager.assign(
+            ids, round_idx,
+            fetch_hot=lambda: np.asarray(jax.device_get(state["ledger"])),
+        )
+        if len(new_slots):
+            upd = self._put(jnp.asarray(seed_rows), self._data_sharding)
+            at = self._put(jnp.asarray(new_slots), self._data_sharding)
+            state["ledger"] = state["ledger"].at[at].set(upd)
+            self._ledger_ref = state["ledger"]
+        return slots
+
     def _log_ledger(self, round_idx: int) -> Optional[np.ndarray]:
         """Emit one columnar `client_ledger` JSONL record from the
         device-resident ledger (rows with at least one participation).
@@ -2096,47 +2286,131 @@ class Experiment:
             return None
         from colearn_federated_learning_tpu.obs.ledger import LEDGER_COLS
 
-        led = np.asarray(jax.device_get(self._ledger_ref))
-        active = np.flatnonzero(led[:, 0] > 0)
+        ids, rows = self._fetch_ledger_rows()
         rec: Dict[str, Any] = {
             "event": "client_ledger",
             "round": int(round_idx),
-            "num_clients": int(led.shape[0]),
+            "num_clients": int(self.fed.num_clients),
             "ema": self._ledger_cfg.ema,
             "zmax": self._ledger_cfg.zmax,
-            "ids": [int(i) for i in active],
-            "count": [int(v) for v in led[active, 0]],
-            "flagged": [int(v) for v in led[active, 1]],
+            "ids": [int(i) for i in ids],
+            "count": [int(v) for v in rows[:, 0]],
+            "flagged": [int(v) for v in rows[:, 1]],
         }
         for j, col in enumerate(LEDGER_COLS[2:], start=2):
-            rec[col] = [round(float(v), 6) for v in led[active, j]]
+            rec[col] = [round(float(v), 6) for v in rows[:, j]]
         self.logger.log(rec)
         self._ledger_logged_round = int(round_idx)
-        return led
+        return ids, rows
+
+    def _fetch_ledger_rows(self):
+        """ONE blocking device fetch of the ledger, reduced to the
+        columnar active view ``(client ids, [A, LEDGER_WIDTH] rows)`` —
+        ids ascending, one row per client with ≥1 participation. Dense:
+        a flatnonzero over the fetched store. Paged: the hot set is
+        written back into the cold mmap and the merged view scanned —
+        client ids throughout, never slots, so records/reports/snapshots
+        are layout-independent (paged ≡ dense, test-pinned)."""
+        hot = np.asarray(jax.device_get(self._ledger_ref))
+        if self._pager is not None:
+            return self._pager.active_rows(hot)
+        active = np.flatnonzero(hot[:, 0] > 0)
+        return active, hot[active]
 
     def _refresh_adaptive_snapshot(self, round_idx: int) -> None:
-        """Refresh the adaptive sampler's ledger snapshot at a
-        ``log_every`` round boundary: ONE blocking device fetch of the
-        ledger (the same fetch emits the periodic ``client_ledger``
-        JSONL record — the flush is the sampler's feed). The refresh
-        rounds are pure round arithmetic (multiples of log_every —
-        chunk boundaries under fuse_rounds, enforced by validate()), so
-        a resumed run refreshes at exactly the rounds the straight run
-        did; between refreshes the checkpointed snapshot covers it."""
+        """Refresh the sampler's ledger view at a ``log_every`` round
+        boundary: ONE blocking device fetch of the ledger (the same
+        fetch emits the periodic ``client_ledger`` JSONL record — the
+        flush is the sampler's feed). The refresh rounds are pure round
+        arithmetic (multiples of log_every — chunk boundaries under
+        fuse_rounds, enforced by validate()), so a resumed run
+        refreshes at exactly the rounds the straight run did; between
+        refreshes the checkpointed snapshot/sketch covers it.
+
+        Only the three scored columns flow to the sampler
+        (sampler.SNAPSHOT_COLS — count, flagged, ema_loss):
+        ``adaptive`` scatters them into its dense [num_clients, 3]
+        snapshot; ``streaming`` keeps the fixed-size columnar sketch
+        (top participation, ties by id) and never builds anything
+        O(num_clients)."""
+        if self._ledger_ref is None:
+            return
         if self._ledger_logged_round == round_idx:
             # a flush boundary already logged (and fetched) this exact
             # round — fetch without emitting a duplicate JSONL record
-            led = (
-                np.asarray(jax.device_get(self._ledger_ref))
-                if self._ledger_ref is not None else None
-            )
+            ids, rows = self._fetch_ledger_rows()
         else:
-            led = self._log_ledger(round_idx)
-        if led is None:
-            return
-        self._sampler_snapshot = led
+            ids, rows = self._log_ledger(round_idx)
+        # LEDGER_COLS → SNAPSHOT_COLS: count, flagged, ema_loss
+        cols = rows[:, [0, 1, 5]].astype(np.float32)
         self._sampler_snapshot_round = int(round_idx)
-        self.sampler.observe_snapshot(led, round_idx)
+        if self._adaptive:
+            dense = np.zeros((self.fed.num_clients, 3), np.float32)
+            dense[ids] = cols
+            self._sampler_snapshot = dense
+            self.sampler.observe_snapshot(dense, round_idx)
+            return
+        m = len(self._sketch_ids)
+        if len(ids) > m:
+            keep = np.sort(np.lexsort((ids, -cols[:, 0]))[:m])
+            ids, cols = ids[keep], cols[keep]
+        self._sketch_ids = np.full(m, -1, np.int32)
+        self._sketch_ids[: len(ids)] = ids
+        self._sketch_stats = np.zeros((m, 3), np.float32)
+        self._sketch_stats[: len(ids)] = cols
+        self.sampler.observe_snapshot(
+            {
+                "ids": ids,
+                "count": cols[:, 0],
+                "flagged": cols[:, 1],
+                "ema_loss": cols[:, 2],
+            } if len(ids) else None,
+            round_idx,
+        )
+
+    def _seed_sampler_from_state(self, state: Dict[str, Any]) -> None:
+        """Feed the sampler the checkpoint's ACTIVE snapshot (adaptive)
+        or score sketch (streaming) so a resumed run scores mid-window
+        rounds exactly like the straight run did (zeros / empty sketch
+        on a fresh run → the uniform all-unseen prior)."""
+        self._sampler_snapshot_round = int(state["ledger_snapshot_round"])
+        if self._adaptive:
+            self._sampler_snapshot = state["ledger_snapshot"]
+            self.sampler.observe_snapshot(
+                self._sampler_snapshot, self._sampler_snapshot_round
+            )
+            return
+        self._sketch_ids = np.asarray(state["ledger_sketch_ids"], np.int32)
+        self._sketch_stats = np.asarray(
+            state["ledger_sketch_stats"], np.float32
+        )
+        live = self._sketch_ids >= 0
+        self.sampler.observe_snapshot(
+            {
+                "ids": self._sketch_ids[live],
+                "count": self._sketch_stats[live, 0],
+                "flagged": self._sketch_stats[live, 1],
+                "ema_loss": self._sketch_stats[live, 2],
+            } if live.any() else None,
+            self._sampler_snapshot_round,
+        )
+
+    def _carry_host_ledger_state(self, state: Dict[str, Any]) -> None:
+        """run_round returns a fresh state dict holding only the round
+        program's outputs — re-attach the host-side sampler snapshot /
+        sketch and the pager's cold-spill bookkeeping so they ride
+        every checkpoint."""
+        if self._snapshot_refresh:
+            state["ledger_snapshot_round"] = self._sampler_snapshot_round
+            if self._adaptive:
+                state["ledger_snapshot"] = self._sampler_snapshot
+            else:
+                state["ledger_sketch_ids"] = self._sketch_ids
+                state["ledger_sketch_stats"] = self._sketch_stats
+        if self._pager is not None:
+            state["ledger_cold"] = self._pager.cold
+            state["ledger_slots"] = self._pager.slot_clients
+            state["ledger_slot_used"] = self._pager.slot_used
 
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         caller_state = state is not None
@@ -2236,6 +2510,13 @@ class Experiment:
                     # rebuild
                     **{k: int(v) for k, v in self._db_stats.items()},
                     **{k: int(v) for k, v in self._run_totals.items()},
+                    # ledger paging accounting: evictions are the cold
+                    # spills, page_syncs the blocking hot-set fetches
+                    # they forced (0 when the working set fit)
+                    **({
+                        "ledger_evictions": int(self._pager.evictions),
+                        "ledger_page_syncs": int(self._pager.page_syncs),
+                    } if self._pager is not None else {}),
                 })
             except Exception as e:
                 print(f"run_summary log failed: {e}", flush=True)
@@ -2309,16 +2590,12 @@ class Experiment:
         state = self._place_state(state)
         if self._ledger_on:
             self._ledger_ref = state.get("ledger")
-        if self._adaptive:
-            # seed the sampler with the checkpoint's ACTIVE snapshot
-            # (zeros on a fresh run → the uniform all-unseen prior);
-            # refreshes at later log_every boundaries override it at
-            # exactly the rounds the straight run refreshed
-            self._sampler_snapshot = state["ledger_snapshot"]
-            self._sampler_snapshot_round = int(state["ledger_snapshot_round"])
-            self.sampler.observe_snapshot(
-                self._sampler_snapshot, self._sampler_snapshot_round
-            )
+        if self._snapshot_refresh:
+            # seed the sampler with the checkpoint's ACTIVE snapshot /
+            # sketch (zeros/empty on a fresh run → the uniform all-
+            # unseen prior); refreshes at later log_every boundaries
+            # override it at exactly the rounds the straight run did
+            self._seed_sampler_from_state(state)
         start_round = int(state["round"])
         self._rounds_done = max(self._rounds_done, start_round)
         if start_round == 0:
@@ -2600,11 +2877,7 @@ class Experiment:
                     state = self.run_round(state, r, fuse_override=1)
                 if self._ledger_on:
                     self._ledger_ref = state.get("ledger")
-                if self._adaptive:
-                    state["ledger_snapshot"] = self._sampler_snapshot
-                    state["ledger_snapshot_round"] = (
-                        self._sampler_snapshot_round
-                    )
+                self._carry_host_ledger_state(state)
                 pending.append((r, state.pop("_metrics")))
             flush(state)
             start_round = aligned
@@ -2619,14 +2892,11 @@ class Experiment:
                     state = self.run_round(state, r)
                 if self._ledger_on:
                     self._ledger_ref = state.get("ledger")
-                if self._adaptive:
-                    # the ACTIVE snapshot rides every checkpoint so a
-                    # resume scores mid-window rounds exactly like the
-                    # straight run (run_round returns a fresh dict)
-                    state["ledger_snapshot"] = self._sampler_snapshot
-                    state["ledger_snapshot_round"] = (
-                        self._sampler_snapshot_round
-                    )
+                # the ACTIVE snapshot/sketch + pager bookkeeping ride
+                # every checkpoint so a resume scores mid-window rounds
+                # (and replays slot assignment) exactly like the
+                # straight run (run_round returns a fresh dict)
+                self._carry_host_ledger_state(state)
                 ms = state.pop("_metrics")
                 if fuse == 1:
                     pending.append((r, ms))

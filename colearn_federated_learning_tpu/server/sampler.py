@@ -14,18 +14,47 @@ fixed round boundaries (``run.obs.client_ledger.log_every`` multiples,
 driven by the round driver) and rides the checkpoint — so a resumed
 run still replays the straight run's schedule exactly, including
 through a snapshot boundary (test-pinned).
+
+``mode="streaming"`` (``server.sampling="streaming"``) is the
+million-client mode: every draw is O(cohort·log), never touching a
+dense ``[num_clients]`` structure. Without ledger evidence it is a
+uniform without-replacement rejection draw; once the driver feeds it a
+compact *score sketch* (the columnar ``{ids, count, flagged,
+ema_loss}`` table of observed clients — bounded by
+``server.adaptive.sketch_size``), draws score the SAME Oort formula as
+"adaptive" over the sketch rows plus a closed-form optimistic pool for
+the (num_clients − sketch) unseen clients. Pure in ``(seed, r,
+sketch)`` — same resume-replay contract as adaptive — but a different
+deterministic sequence than the dense modes (different draw
+algorithm; documented, and the parity pins always compare runs using
+the same mode).
+
+Snapshots are COLUMN-SLIMMED (PR 9): the sampler consumes only the
+three ledger columns it scores — :data:`SNAPSHOT_COLS` = (count,
+flagged, ema_loss) — as a dense ``[num_clients, 3]`` block (adaptive)
+or the columnar sketch dict (streaming), never the full
+``[num_clients, LEDGER_WIDTH]`` row block. The driver's snapshot fetch
+and the checkpointed sampler state shrink accordingly.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Union
 
 import numpy as np
 
-# ledger column indices the adaptive score reads (obs/ledger.py
-# LEDGER_COLS order: count, flagged, ema_l2, ema_cos, ema_resid,
-# ema_loss, ema_z)
-_COUNT, _FLAGGED, _EMA_LOSS = 0, 1, 5
+# the ledger columns the sampler scores, in snapshot-column order
+# (obs/ledger.py LEDGER_COLS names; the driver slices these out of the
+# fetched ledger — the sampler never sees the other stat columns)
+SNAPSHOT_COLS = ("count", "flagged", "ema_loss")
+_COUNT, _FLAGGED, _EMA_LOSS = 0, 1, 2
+
+# streaming rejection draws: expected retries are ~1 at K << N; this is
+# a pure safety net against adversarial (explore≈0, mass-concentrated)
+# corners — the deterministic sweep below it keeps sample() total
+_MAX_DRAW_TRIES_PER_SLOT = 512
+
+Snapshot = Union[np.ndarray, Dict[str, np.ndarray], None]
 
 
 class CohortSampler:
@@ -34,10 +63,11 @@ class CohortSampler:
                  mode: str = "fixed",
                  explore: float = 0.1,
                  staleness_gain: float = 1.0,
-                 flag_suppress: float = 4.0):
+                 flag_suppress: float = 4.0,
+                 sketch_size: int = 4096):
         if cohort_size > num_clients:
             raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
-        if mode not in ("fixed", "poisson", "adaptive"):
+        if mode not in ("fixed", "poisson", "adaptive", "streaming"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         self.num_clients = num_clients
         self.cohort_size = cohort_size
@@ -46,15 +76,18 @@ class CohortSampler:
         self.explore = float(explore)
         self.staleness_gain = float(staleness_gain)
         self.flag_suppress = float(flag_suppress)
+        self.sketch_size = int(sketch_size)
         # adaptive: the last observed ledger snapshot (None until the
-        # driver feeds one — the all-unseen prior is a uniform draw)
+        # driver feeds one — the all-unseen prior is a uniform draw);
+        # streaming keeps the columnar sketch instead
         self.snapshot_round: int = 0
+        self._sketch: Optional[Dict[str, np.ndarray]] = None
         if weights is not None:
-            if mode in ("poisson", "adaptive"):
+            if mode in ("poisson", "adaptive", "streaming"):
                 raise ValueError(
                     "static weights only apply to mode='fixed' (poisson "
-                    "is unweighted q = K/N; adaptive derives its own "
-                    "scores from the ledger)"
+                    "is unweighted q = K/N; adaptive/streaming derive "
+                    "their own scores from the ledger)"
                 )
             w = np.asarray(weights, np.float64)
             # a silent NaN here used to surface rounds later as an
@@ -87,42 +120,86 @@ class CohortSampler:
         """Per-client per-round participation probability (poisson)."""
         return self.cohort_size / self.num_clients
 
-    # ---- adaptive scoring (mode="adaptive") --------------------------
+    # ---- adaptive scoring (modes "adaptive" and "streaming") ---------
 
-    def observe_snapshot(self, ledger: Optional[np.ndarray],
-                         round_idx: int) -> None:
-        """Refresh the adaptive draw probabilities from a host-side
-        ledger snapshot (``[num_clients, LEDGER_WIDTH]``; None resets
-        to the uniform prior). Deterministic: the same (snapshot,
-        round) always yields the same probabilities, so the schedule
-        stays replayable across resume."""
-        if self.mode != "adaptive":
+    def observe_snapshot(self, snapshot: Snapshot, round_idx: int) -> None:
+        """Refresh the draw scores from a ledger snapshot. Accepts a
+        dense ``[num_clients, 3]`` block in :data:`SNAPSHOT_COLS` order
+        (the adaptive checkpoint form), a columnar dict ``{"ids",
+        "count", "flagged", "ema_loss"}`` of observed clients only (the
+        streaming sketch form — O(observed), never O(num_clients)), or
+        None (reset to the uniform all-unseen prior). Deterministic:
+        the same (snapshot, round) always yields the same draw
+        distribution, so the schedule stays replayable across resume."""
+        if self.mode not in ("adaptive", "streaming"):
             raise ValueError(
-                f"observe_snapshot only applies to mode='adaptive' "
-                f"(this sampler is {self.mode!r})"
+                f"observe_snapshot only applies to mode='adaptive' or "
+                f"'streaming' (this sampler is {self.mode!r})"
             )
         self.snapshot_round = int(round_idx)
-        if ledger is None:
+        if snapshot is None:
             self.probs = None
+            self._sketch = None
             return
-        led = np.asarray(ledger, np.float64)
-        if led.shape[0] != self.num_clients:
-            raise ValueError(
-                f"ledger snapshot has {led.shape[0]} rows, sampler "
-                f"tracks {self.num_clients} clients"
-            )
-        self.probs = self._adaptive_probs(led, self.snapshot_round)
+        if isinstance(snapshot, dict):
+            ids = np.asarray(snapshot["ids"], np.int64)
+            cols = {
+                c: np.asarray(snapshot[c], np.float64) for c in SNAPSHOT_COLS
+            }
+            if any(v.shape != ids.shape for v in cols.values()):
+                raise ValueError(
+                    "snapshot columns must all match ids in shape"
+                )
+            if ids.size and (ids.min() < 0 or ids.max() >= self.num_clients):
+                raise ValueError(
+                    f"snapshot ids out of range [0, {self.num_clients})"
+                )
+            if self.mode == "streaming":
+                self._sketch = self._cap_sketch(ids, cols)
+                return
+            dense = np.zeros((self.num_clients, len(SNAPSHOT_COLS)))
+            for j, c in enumerate(SNAPSHOT_COLS):
+                dense[ids, j] = cols[c]
+        else:
+            dense = np.asarray(snapshot, np.float64)
+            if dense.shape != (self.num_clients, len(SNAPSHOT_COLS)):
+                raise ValueError(
+                    f"dense snapshot must be [num_clients, "
+                    f"{len(SNAPSHOT_COLS)}] in {SNAPSHOT_COLS} order; got "
+                    f"shape {dense.shape} for {self.num_clients} clients"
+                )
+            if self.mode == "streaming":
+                ids = np.flatnonzero(dense[:, _COUNT] > 0)
+                self._sketch = self._cap_sketch(ids, {
+                    c: dense[ids, j] for j, c in enumerate(SNAPSHOT_COLS)
+                })
+                return
+        self.probs = self._adaptive_probs(dense, self.snapshot_round)
+
+    def _cap_sketch(self, ids, cols) -> Optional[Dict[str, np.ndarray]]:
+        """Bound the sketch at ``sketch_size`` rows, keeping the
+        highest-participation clients (ties broken by id — the same
+        deterministic priority the driver's checkpointed sketch uses)."""
+        if ids.size == 0:
+            return None
+        if len(ids) > self.sketch_size:
+            order = np.lexsort((ids, -cols["count"]))[: self.sketch_size]
+            keep = np.sort(ids[order])
+            sel = np.isin(ids, keep)
+            ids = ids[sel]
+            cols = {c: v[sel] for c, v in cols.items()}
+        return {"ids": ids, **cols}
 
     def _adaptive_probs(self, led: np.ndarray,
                         snap_round: int) -> Optional[np.ndarray]:
-        """Oort-style scores → draw probabilities. Per client:
-        loss-utility EMA (unseen clients take the max seen utility —
-        optimistic initialization, so exploration is eager rather than
-        starved) × a participation-staleness boost (deficit vs the
-        uniform expectation ``round·K/N``) × exponential suppression of
-        high-flag-rate clients; then the exploration floor mixes
-        ``explore/N`` uniformly so no client's probability ever reaches
-        zero."""
+        """Oort-style scores → draw probabilities (dense adaptive mode).
+        Per client: loss-utility EMA (unseen clients take the max seen
+        utility — optimistic initialization, so exploration is eager
+        rather than starved) × a participation-staleness boost (deficit
+        vs the uniform expectation ``round·K/N``) × exponential
+        suppression of high-flag-rate clients; then the exploration
+        floor mixes ``explore/N`` uniformly so no client's probability
+        ever reaches zero."""
         count = led[:, _COUNT]
         seen = count > 0
         if not seen.any():
@@ -147,6 +224,84 @@ class CohortSampler:
         )
         return probs / probs.sum()  # exact renormalization for rng.choice
 
+    def _sketch_scores(self):
+        """(per-row scores, unseen-pool per-client score) — the SAME
+        Oort formula as :meth:`_adaptive_probs`, evaluated only over
+        the sketch's observed rows plus one closed-form score shared by
+        every unseen client (count 0 ⇒ optimistic max-seen utility, the
+        full staleness boost, flag rate 0)."""
+        sk = self._sketch
+        count = sk["count"]
+        util = np.maximum(sk["ema_loss"], 0.0)
+        opt = max(float(util.max()) if len(util) else 0.0, 1e-6)
+        flag_rate = sk["flagged"] / np.maximum(count, 1.0)
+        expected = self.snapshot_round * self.cohort_size / self.num_clients
+        deficit = np.maximum(expected - count, 0.0)
+        staleness = 1.0 + self.staleness_gain * deficit / max(expected, 1.0)
+        scores = (
+            (util + 1e-6) * staleness * np.exp(-self.flag_suppress * flag_rate)
+        )
+        unseen_staleness = (
+            1.0 + self.staleness_gain * expected / max(expected, 1.0)
+        )
+        unseen = (opt + 1e-6) * unseen_staleness
+        return scores, unseen
+
+    # ---- streaming draw ----------------------------------------------
+
+    def _fill_deterministic(self, out: set) -> None:
+        """Pathological-corner backstop (the rejection loop exhausted
+        its try budget): complete the cohort with the smallest unchosen
+        ids — still deterministic, never an infinite loop."""
+        for c in range(self.num_clients):
+            if len(out) >= self.cohort_size:
+                return
+            out.add(c)
+
+    def _sample_streaming(self, rng) -> np.ndarray:
+        """O(cohort·log sketch) cohort draw: each slot draws from the
+        exploration floor (uniform over all N), the sketch table
+        (binary search over the score cumsum), or the unseen pool
+        (uniform with seen-ids rejection) — duplicates rejected, so the
+        cohort is without replacement like the dense modes. No dense
+        [num_clients] structure is ever built."""
+        n, k = self.num_clients, self.cohort_size
+        out: set = set()
+        sk = self._sketch
+        if sk is None:
+            cum = np.zeros(0)
+            ids = np.zeros(0, np.int64)
+            id_set: set = set()
+            total_obs = total = 0.0
+        else:
+            scores, unseen = self._sketch_scores()
+            ids = sk["ids"]
+            id_set = {int(i) for i in ids}
+            cum = np.cumsum(scores)
+            total_obs = float(cum[-1]) if len(cum) else 0.0
+            total = total_obs + (n - len(ids)) * unseen
+            if not np.isfinite(total) or total <= 0.0:
+                total = total_obs = 0.0
+        budget = _MAX_DRAW_TRIES_PER_SLOT * k
+        while len(out) < k and budget > 0:
+            budget -= 1
+            if total <= 0.0 or rng.random() < self.explore:
+                cand = int(rng.integers(n))  # exploration floor: uniform
+            else:
+                v = rng.random() * total
+                if v < total_obs:
+                    cand = int(ids[int(np.searchsorted(cum, v, side="right"))])
+                else:
+                    cand = int(rng.integers(n))  # unseen pool
+                    if cand in id_set:
+                        continue  # landed on a seen id: not this pool's
+            if cand in out:
+                continue
+            out.add(cand)
+        if len(out) < k:
+            self._fill_deterministic(out)
+        return np.sort(np.fromiter(out, np.int64, len(out)))
+
     # ------------------------------------------------------------------
 
     def sample(self, round_idx: int) -> np.ndarray:
@@ -158,6 +313,8 @@ class CohortSampler:
             # its static cap. A zero-participant round is legitimate
             # (the engine's degenerate-denominator path handles it).
             return np.flatnonzero(rng.random(self.num_clients) < self.q)
+        if self.mode == "streaming":
+            return self._sample_streaming(rng)
         return np.sort(
             rng.choice(self.num_clients, size=self.cohort_size,
                        replace=False, p=self.probs)

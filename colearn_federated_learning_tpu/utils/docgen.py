@@ -15,6 +15,23 @@ from colearn_federated_learning_tpu import config as config_mod
 _SECTIONS = [
     ("model", config_mod.ModelConfig, "Model selection (zoo name + per-family kwargs)."),
     ("data", config_mod.DataConfig, "Dataset, federation partition, placement."),
+    ("data.store", config_mod.StoreConfig,
+     "On-disk memory-mapped client store (data/store.py) — the "
+     "million-client data path. `colearn store build` converts a "
+     "config's data (or streams a synthetic federation at any client "
+     "count) into fixed-record binary shards + a small per-client "
+     "offset/length index; with `dir` set the corpus stays on disk "
+     "behind np.memmap views and the host pipeline gathers only the "
+     "sampled cohort's records into each round's slab — every "
+     "host-side structure the round loop touches is O(cohort). "
+     "Store-backed runs are BITWISE-equal to the in-memory run the "
+     "store was converted from on the same seed and host pipeline "
+     "(pin run.host_pipeline explicitly when comparing — 'auto' may "
+     "pick the native pipeline for the in-memory run while the store "
+     "path always uses NumPy). Pair with data.placement=\"stream\" + "
+     "server.sampling=\"streaming\" (+ client_ledger.hot_capacity for "
+     "the paged ledger) for the full O(cohort) story. See "
+     "docs/DESIGN.md \"Client store & million-client scaling\"."),
     ("client", config_mod.ClientConfig, "Per-client local training."),
     ("server", config_mod.ServerConfig,
      "Round schedule, aggregation, algorithms' server-side knobs."),

@@ -108,6 +108,35 @@ def test_bench_shapes_validate_and_divide_fuse():
         assert warmup % fuse == 0 and timed % fuse == 0, (name, fuse)
 
 
+def test_peak_host_rss_is_measurable():
+    """Every bench result now records num_clients + peak host RSS (the
+    clients-scale axis, ROADMAP item 1): the measurement itself must be
+    a sane positive MB figure on this platform."""
+    rss = bench._peak_host_rss_mb()
+    assert isinstance(rss, float) and 1.0 < rss < 1_000_000.0
+    # monotone: a later reading never shrinks (ru_maxrss is a peak)
+    assert bench._peak_host_rss_mb() >= rss
+
+
+def test_store_scale_configs_validate():
+    """The clients-scale bench entries (store_scale_1k/1m) must build a
+    validating config — at the 1k scale end-to-end shape, without
+    paying the store build here (bench does that lazily)."""
+    from colearn_federated_learning_tpu.config import get_named_config
+
+    assert set(bench._STORE_SCALE) == {"store_scale_1k", "store_scale_1m"}
+    for n in bench._STORE_SCALE.values():
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.apply_overrides({
+            "data.num_clients": n, "data.store.dir": "/nonexistent",
+            "data.placement": "stream", "server.sampling": "streaming",
+            "server.cohort_size": 16, "client.batch_size": 2,
+            "server.num_rounds": 8, "server.eval_every": 0,
+            "run.out_dir": "",
+        })
+        cfg.validate()
+
+
 def test_mfu_basis_tracks_compute_dtype():
     """r7 hygiene: bf16-compute configs divide by the bf16 peak, pure
     f32 configs by the f32 stand-in — and the basis is recorded."""

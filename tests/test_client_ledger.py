@@ -438,6 +438,141 @@ def test_engine_compat_mirror_rejects_unsound_ledger():
 
 
 # ---------------------------------------------------------------------------
+# paged ledger (run.obs.client_ledger.hot_capacity): [hot, 7] device hot
+# set + host mmap cold spill — merged view bitwise-equal to dense
+# ---------------------------------------------------------------------------
+
+
+def _merged_ledger(exp, state):
+    led = _ledger(state)
+    if exp._pager is not None:
+        return exp._pager.merged(led)
+    return led
+
+
+def _fit_merged(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    return exp, state, _merged_ledger(exp, state)
+
+
+@pytest.mark.parametrize("engine", ["sharded", "sequential"])
+def test_paged_ledger_merged_equals_dense(tmp_path, engine):
+    """hot_capacity 5 < 8 clients with cohort 4 forces real page-ins and
+    LRU evictions; the merged (hot ∪ cold) ledger must equal the dense
+    run's BITWISE, and — with reputation feeding trust from the paged
+    rows — the params trajectory too (paging invisible to the program)."""
+    over = {
+        "attack.kind": "sign_flip", "attack.fraction": 0.25,
+        "server.reputation.enabled": True,
+    }
+    _, d_state, d_led = _fit_merged(_cfg(tmp_path / "d", engine,
+                                         rounds=6, **over))
+    exp, p_state, p_led = _fit_merged(_cfg(tmp_path / "p", engine, rounds=6,
+                                           **{**over,
+                                              "run.obs.client_ledger"
+                                              ".hot_capacity": 5}))
+    assert exp._pager is not None
+    assert p_led.shape[0] == 8  # merged view is client-indexed
+    np.testing.assert_array_equal(d_led, p_led)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        d_state["params"], p_state["params"],
+    )
+    # the small hot set genuinely paged (8 distinct clients through 5
+    # slots over 6 rounds cannot avoid evicting)
+    assert exp._pager.evictions >= 1
+    assert exp._pager.page_syncs >= 1
+
+
+def test_paged_ledger_fused_chunk_union(tmp_path):
+    """Under fuse_rounds the whole chunk's cohort union is slot-assigned
+    before dispatch — fused paged == fused dense bitwise (hot capacity
+    exactly the worst-case union, the construction-check floor)."""
+    _, _, d_led = _fit_merged(_cfg(tmp_path / "d", fuse=2, rounds=6))
+    exp, _, p_led = _fit_merged(_cfg(
+        tmp_path / "p", fuse=2, rounds=6,
+        **{"run.obs.client_ledger.hot_capacity": 8}
+    ))
+    np.testing.assert_array_equal(d_led, p_led)
+
+
+def test_paged_ledger_checkpoint_resume_roundtrip(tmp_path):
+    """The page-in/page-out roundtrip through checkpoint/resume: hot
+    array, slot maps, and the cold spill all ride the checkpoint, so a
+    resumed run replays slot assignment and lands the same merged
+    ledger (and JSONL records keep CLIENT ids, never slots)."""
+    over = {
+        "run.obs.client_ledger.hot_capacity": 5,
+        "run.obs.client_ledger.log_every": 2,
+        "server.checkpoint_every": 3,
+    }
+    _, s_state, s_led = _fit_merged(_cfg(tmp_path / "straight", rounds=6,
+                                         **over))
+    _fit_merged(_cfg(tmp_path / "resumed", rounds=3, **over))
+    exp, r_state, r_led = _fit_merged(_cfg(tmp_path / "resumed", rounds=6,
+                                           **{**over, "run.resume": True}))
+    np.testing.assert_array_equal(s_led, r_led)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        s_state["params"], r_state["params"],
+    )
+    # periodic records carry client ids within [0, num_clients), with
+    # counts matching the merged view
+    path = os.path.join(str(tmp_path / "resumed"),
+                        "mnist_fedavg_2.metrics.jsonl")
+    recs = [json.loads(l) for l in open(path)]
+    led_recs = [r for r in recs if r.get("event") == "client_ledger"]
+    assert led_recs
+    final = led_recs[-1]
+    assert final["num_clients"] == 8
+    assert all(0 <= i < 8 for i in final["ids"])
+    np.testing.assert_array_equal(
+        r_led[np.asarray(final["ids"], int), _COUNT],
+        np.asarray(final["count"], np.float32),
+    )
+    # run_summary records the paging accounting
+    rs = [r for r in recs if r.get("event") == "run_summary"][-1]
+    assert "ledger_evictions" in rs and "ledger_page_syncs" in rs
+
+
+def test_paged_ledger_capacity_and_pairing_rejections(tmp_path):
+    # hot set smaller than one dispatch's cohort: construction-time error
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    cfg = _cfg(tmp_path, **{"run.obs.client_ledger.hot_capacity": 3})
+    with pytest.raises(ValueError, match="hot_capacity=3"):
+        Experiment(cfg, echo=False)
+    # fused: the floor is the chunk union (cohort × fuse)
+    cfg = _cfg(tmp_path / "f", fuse=2,
+               **{"run.obs.client_ledger.hot_capacity": 6})
+    with pytest.raises(ValueError, match="fuse_rounds=2"):
+        Experiment(cfg, echo=False)
+    # EF shares the cohort-id input the pager remaps: rejected
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "run.obs.client_ledger.enabled": True,
+        "run.obs.client_ledger.hot_capacity": 4,
+        "server.compression": "qsgd", "server.error_feedback": True,
+    })
+    with pytest.raises(ValueError, match="error_feedback"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.run.obs.client_ledger.hot_capacity = -1
+    with pytest.raises(ValueError, match="hot_capacity"):
+        cfg.validate()
+    # hot_capacity >= num_clients degrades to the dense store
+    cfg = _cfg(tmp_path / "dense",
+               **{"run.obs.client_ledger.hot_capacity": 8})
+    exp = Experiment(cfg, echo=False)
+    assert exp._pager is None
+
+
+# ---------------------------------------------------------------------------
 # tier-1 CPU smoke: the headline adversarial config with the ledger on
 # ---------------------------------------------------------------------------
 

@@ -91,6 +91,29 @@ def test_dirichlet_extreme_alpha_repair_is_surfaced():
         np.testing.assert_array_equal(a, b)
 
 
+def test_iid_more_clients_than_examples_is_clear_error():
+    """num_clients > n used to yield silently-empty shards that only
+    surfaced rounds later as an opaque eval error — both partitioners
+    must raise at partition time, naming both numbers."""
+    with pytest.raises(ValueError, match="12 clients over 10 examples"):
+        P.iid_partition(10, 12, seed=0)
+    # silo shares the iid path
+    with pytest.raises(ValueError, match="clients over"):
+        P.silo_partition(10, 12, seed=0)
+    # boundary: exactly one example per client is fine
+    shards = P.iid_partition(12, 12, seed=0)
+    assert all(len(s) == 1 for s in shards)
+
+
+def test_dirichlet_more_clients_than_examples_is_clear_error():
+    y = np.zeros(10, np.int32)
+    with pytest.raises(ValueError, match="10 examples cannot give 12"):
+        P.dirichlet_partition(y, 12, 1, alpha=0.5, seed=0)
+    # and through the top-level dispatcher (the config path)
+    with pytest.raises(ValueError, match="cannot give"):
+        P.partition("dirichlet", y, 12, 1, alpha=0.5, seed=0)
+
+
 def test_dirichlet_no_repair_reports_false():
     y = _labels()
     info = {}

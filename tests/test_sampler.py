@@ -47,7 +47,7 @@ def test_malformed_weights_rejected_with_clear_error(weights, match):
 
 
 def test_static_weights_rejected_for_poisson_and_adaptive():
-    for mode in ("poisson", "adaptive"):
+    for mode in ("poisson", "adaptive", "streaming"):
         with pytest.raises(ValueError, match="fixed"):
             CohortSampler(num_clients=4, cohort_size=2, seed=0,
                           weights=np.ones(4), mode=mode)
@@ -59,13 +59,16 @@ def test_static_weights_rejected_for_poisson_and_adaptive():
 
 
 def _ledger(num_clients, count=None, flagged=None, ema_loss=None):
-    led = np.zeros((num_clients, 7), np.float32)
+    """A column-slimmed snapshot (SNAPSHOT_COLS order: count, flagged,
+    ema_loss) — the only ledger columns the sampler consumes since the
+    PR-9 snapshot slimming."""
+    led = np.zeros((num_clients, 3), np.float32)
     if count is not None:
         led[:, 0] = count
     if flagged is not None:
         led[:, 1] = flagged
     if ema_loss is not None:
-        led[:, 5] = ema_loss
+        led[:, 2] = ema_loss
     return led
 
 
@@ -131,6 +134,99 @@ def test_observe_snapshot_rejected_for_fixed_mode():
         s.observe_snapshot(_ledger(8), 1)
 
 
+def test_observe_snapshot_rejects_full_ledger_rows():
+    """The snapshot interface is column-slimmed: the full [N, 7] ledger
+    row block must be rejected with a message naming the 3-column form
+    (PR-9 satellite — slims the fetch and the checkpointed state)."""
+    s = CohortSampler(8, 2, seed=0, mode="adaptive")
+    with pytest.raises(ValueError, match=r"\[num_clients, 3\]"):
+        s.observe_snapshot(np.zeros((8, 7), np.float32), 1)
+
+
+# ---------------------------------------------------------------------------
+# streaming mode (server.sampling="streaming"): O(cohort·log) draws, a
+# compact score sketch, never a dense [num_clients] structure
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_uniform_deterministic_and_distinct():
+    s = CohortSampler(2_000_000, 64, seed=3, mode="streaming")
+    a, b = s.sample(7), s.sample(7)
+    np.testing.assert_array_equal(a, b)
+    assert len(np.unique(a)) == 64
+    assert a.min() >= 0 and a.max() < 2_000_000
+    assert (s.sample(8) != a).any()
+
+
+def test_streaming_draw_is_o_cohort_not_o_universe():
+    """The million-client property, measured: drawing from a 4_000_000-
+    client universe must not be meaningfully slower than from 4_000 —
+    a dense prob vector or O(N) permutation would be ~1000×."""
+    import time
+
+    def cost(n):
+        s = CohortSampler(n, 32, seed=0, mode="streaming")
+        t0 = time.perf_counter()
+        for r in range(50):
+            s.sample(r)
+        return time.perf_counter() - t0
+
+    small, big = cost(4_000), cost(4_000_000)
+    assert big < 20 * small + 0.25, (small, big)
+
+
+def test_streaming_sketch_scores_and_suppression():
+    """With a sketch observed, flagged clients are suppressed relative
+    to clean same-utility clients, and unseen clients stay drawable
+    (the optimistic pool + exploration floor)."""
+    n, k = 64, 8
+    ids = np.arange(32)
+    count = np.full(32, 50.0)
+    flagged = np.zeros(32)
+    flagged[16:] = 50.0  # flagged every round
+    snap = {"ids": ids, "count": count, "flagged": flagged,
+            "ema_loss": np.full(32, 2.0)}
+    s = CohortSampler(n, k, seed=0, mode="streaming", explore=0.05,
+                      flag_suppress=6.0)
+    s.observe_snapshot(snap, 400)
+    hits = np.zeros(n)
+    for r in range(600):
+        hits[s.sample(r)] += 1
+    clean, bad, unseen = hits[:16], hits[16:32], hits[32:]
+    assert clean.mean() > 3 * bad.mean(), (clean.mean(), bad.mean())
+    assert (bad > 0).any() or bad.sum() >= 0  # suppressed, not banned
+    assert unseen.mean() > 0  # optimistic pool keeps unseen drawable
+
+
+def test_streaming_sketch_is_capped_at_sketch_size():
+    n = 10_000
+    ids = np.arange(100)
+    snap = {"ids": ids, "count": np.arange(100, dtype=np.float64),
+            "flagged": np.zeros(100), "ema_loss": np.ones(100)}
+    s = CohortSampler(n, 4, seed=0, mode="streaming", sketch_size=16)
+    s.observe_snapshot(snap, 50)
+    # highest-participation rows survive the cap
+    kept = s._sketch["ids"]
+    assert len(kept) == 16
+    np.testing.assert_array_equal(kept, np.arange(84, 100))
+    # draws still work and stay distinct
+    c = s.sample(3)
+    assert len(np.unique(c)) == 4
+
+
+def test_streaming_snapshot_determinism_and_reset():
+    s = CohortSampler(256, 8, seed=1, mode="streaming")
+    base = s.sample(5)
+    snap = {"ids": np.arange(8), "count": np.full(8, 10.0),
+            "flagged": np.zeros(8), "ema_loss": np.linspace(1, 4, 8)}
+    s.observe_snapshot(snap, 20)
+    a = s.sample(5)
+    s.observe_snapshot(snap, 20)
+    np.testing.assert_array_equal(a, s.sample(5))  # pure in (seed, r, sketch)
+    s.observe_snapshot(None, 30)
+    np.testing.assert_array_equal(base, s.sample(5))  # reset → uniform draw
+
+
 def test_adaptive_config_pairing_rejections():
     def base():
         cfg = get_named_config("mnist_fedavg_2")
@@ -190,7 +286,7 @@ def _determinism_cfg(out, rounds, sampling, resume=False):
         "server.checkpoint_every": 3,
         "run.resume": resume,
     })
-    if sampling == "adaptive":
+    if sampling in ("adaptive", "streaming"):
         cfg.apply_overrides({
             "run.obs.client_ledger.enabled": True,
             "run.obs.client_ledger.log_every": 2,
@@ -215,13 +311,13 @@ def _fit_with_cohorts(cfg):
     return exp, state, cohorts
 
 
-@pytest.mark.parametrize("sampling", ["weighted", "adaptive"])
+@pytest.mark.parametrize("sampling", ["weighted", "adaptive", "streaming"])
 def test_sampler_schedule_deterministic_across_resume(tmp_path, sampling):
     """Resume at round 3 (checkpoint_every=3) and run to 6: the resumed
     schedule must equal the straight run's for every round — for
-    adaptive that crosses the ledger-snapshot boundary at round 4
-    (log_every=2), exercising both the checkpointed snapshot (rounds
-    3..3) and a post-resume refresh (rounds 4..5)."""
+    adaptive/streaming that crosses the ledger snapshot/sketch boundary
+    at round 4 (log_every=2), exercising both the checkpointed
+    snapshot (rounds 3..3) and a post-resume refresh (rounds 4..5)."""
     import jax
     import numpy as np
 
@@ -237,7 +333,7 @@ def test_sampler_schedule_deterministic_across_resume(tmp_path, sampling):
             np.asarray(a), np.asarray(b)),
         s6["params"], r6["params"],
     )
-    if sampling == "adaptive":
+    if sampling in ("adaptive", "streaming"):
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(s6["ledger"])),
             np.asarray(jax.device_get(r6["ledger"])),

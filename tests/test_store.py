@@ -1,0 +1,315 @@
+"""On-disk mmap client store (data/store.py, `data.store`): shard
+format round-trips, the conversion/streaming builders, the `colearn
+store` CLI, and THE acceptance pin — store-backed runs bitwise-equal to
+the in-memory runs they were converted from, across {sharded,
+sequential} engines × {fuse_rounds 1, 4} × {stream, hbm} placement."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import cli
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.data import build_federated_data
+from colearn_federated_learning_tpu.data.store import (
+    ClientIndexView,
+    build_synthetic_store,
+    open_store,
+    write_store,
+)
+
+
+def _data_cfg():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "server.num_rounds": 4, "server.eval_every": 0,
+        "data.synthetic_train_size": 512, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 64,
+        # the two host pipelines use different permutation RNGs; the
+        # store path always runs NumPy, so the in-memory twin must too
+        # for the bitwise comparison to be about the STORE, not the RNG
+        "run.host_pipeline": "numpy",
+        "run.out_dir": "",
+    })
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def store_dir(tmp_path_factory):
+    """One converted store for the whole module: built from exactly the
+    federated data the in-memory parity runs will see."""
+    cfg = _data_cfg()
+    fed = build_federated_data(cfg.data, seed=cfg.run.seed)
+    out = tmp_path_factory.mktemp("store") / "s"
+    # ~0.1 MB shards over a ~0.4 MB corpus: the parity matrix runs
+    # against a genuinely MULTI-shard store
+    write_store(str(out), fed, shard_mb=0.1)
+    return str(out)
+
+
+# ---------------------------------------------------------------------------
+# format / builders
+# ---------------------------------------------------------------------------
+
+
+def test_conversion_preserves_every_client_byte(store_dir):
+    """(client, position) → example bytes is the invariant the bitwise
+    run parity rests on: check it exhaustively for the converted store."""
+    cfg = _data_cfg()
+    fed = build_federated_data(cfg.data, seed=cfg.run.seed)
+    sfed = open_store(store_dir).as_federated_data(expected_clients=8)
+    np.testing.assert_array_equal(fed.client_sizes(), sfed.client_sizes())
+    for c in range(fed.num_clients):
+        ids = np.asarray(fed.client_indices[c])
+        sids = np.asarray(sfed.client_indices[c])
+        np.testing.assert_array_equal(fed.train_x[ids], sfed.train_x[sids])
+        np.testing.assert_array_equal(fed.train_y[ids], sfed.train_y[sids])
+    np.testing.assert_array_equal(fed.test_x, sfed.test_x)
+    np.testing.assert_array_equal(fed.test_y, sfed.test_y)
+    # the 0.1 MB shard budget forced client-boundary rolls: gathers
+    # above span multiple shard files
+    assert open_store(store_dir).describe()["num_shards"] > 1
+
+
+def test_sharded_record_array_indexing(store_dir):
+    st = open_store(store_dir)
+    x = st.x
+    assert x.ndim == 4 and x.dtype == np.uint8
+    assert len(x) == 512 and x.nbytes == 512 * 28 * 28
+    # int / slice / fancy / bool indexing agree with materialization
+    full = np.asarray(x)
+    np.testing.assert_array_equal(x[7], full[7])
+    np.testing.assert_array_equal(x[3:9], full[3:9])
+    ids = np.asarray([511, 0, 3, 3, 200])  # order + duplicates preserved
+    np.testing.assert_array_equal(x[ids], full[ids])
+    with pytest.raises(IndexError):
+        x.gather([512])
+
+
+def test_client_index_view_is_lazy_and_sized():
+    view = ClientIndexView(np.asarray([3, 0, 2]))
+    assert len(view) == 3
+    np.testing.assert_array_equal(view[0], [0, 1, 2])
+    np.testing.assert_array_equal(view[1], [])
+    np.testing.assert_array_equal(view[2], [3, 4])
+    np.testing.assert_array_equal(view.sizes, [3, 0, 2])
+    with pytest.raises(IndexError):
+        view[3]
+    with pytest.raises(TypeError):
+        view[np.asarray([0, 1])]
+
+
+def test_synthetic_stream_builder_deterministic(tmp_path):
+    a = build_synthetic_store(str(tmp_path / "a"), num_clients=64,
+                              examples_per_client=3, shape=(8, 8, 1),
+                              seed=7, shard_mb=1)
+    b = build_synthetic_store(str(tmp_path / "b"), num_clients=64,
+                              examples_per_client=3, shape=(8, 8, 1),
+                              seed=7)
+    sa, sb = open_store(a), open_store(b)
+    # shard rolling (shard_mb) must not change a single byte
+    np.testing.assert_array_equal(np.asarray(sa.x), np.asarray(sb.x))
+    np.testing.assert_array_equal(np.asarray(sa.y), np.asarray(sb.y))
+    np.testing.assert_array_equal(sa.test_x, sb.test_x)
+    assert sa.describe()["num_clients"] == 64
+    assert sa.counts.sum() == 64 * 3
+    # a different seed is a different federation
+    c = build_synthetic_store(str(tmp_path / "c"), num_clients=64,
+                              examples_per_client=3, shape=(8, 8, 1), seed=8)
+    assert not np.array_equal(np.asarray(sa.x), np.asarray(open_store(c).x))
+
+
+def test_store_num_clients_mismatch_is_clear(store_dir):
+    with pytest.raises(ValueError, match="data.num_clients=9"):
+        open_store(store_dir).as_federated_data(expected_clients=9)
+    cfg = _data_cfg()
+    cfg.data.store.dir = store_dir
+    cfg.data.num_clients = 16
+    cfg.server.cohort_size = 4
+    with pytest.raises(ValueError, match="num_clients"):
+        build_federated_data(cfg.data, seed=0)
+
+
+def test_missing_store_is_clear(tmp_path):
+    with pytest.raises(FileNotFoundError, match="store build"):
+        open_store(str(tmp_path / "nope"))
+
+
+def test_store_pairing_rejections(store_dir):
+    cfg = _data_cfg()
+    cfg.data.store.dir = store_dir
+    cfg.attack.kind = "label_flip"
+    with pytest.raises(ValueError, match="label_flip"):
+        cfg.validate()
+    cfg = _data_cfg()
+    cfg.data.store.dir = store_dir
+    cfg.run.host_pipeline = "native"
+    with pytest.raises(ValueError, match="native"):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pin: store-backed == in-memory BITWISE
+# ---------------------------------------------------------------------------
+
+
+def _fit_params(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    cfg.validate()
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    return exp, state["params"]
+
+
+_PARITY = [
+    # (engine, fuse, placement) — sequential×fuse>1 is invalid by
+    # config, so the matrix is the three valid cells plus the hbm twin
+    ("sharded", 1, "stream"),
+    ("sharded", 4, "stream"),
+    ("sharded", 1, "hbm"),
+    ("sequential", 1, "stream"),
+]
+
+
+@pytest.mark.parametrize("engine,fuse,placement", _PARITY)
+def test_store_backed_bitwise_equals_in_memory(store_dir, engine, fuse,
+                                               placement):
+    cfg = _data_cfg()
+    cfg.apply_overrides({"run.engine": engine, "run.fuse_rounds": fuse})
+    _, p_mem = _fit_params(cfg)
+    cfg = _data_cfg()
+    cfg.apply_overrides({
+        "run.engine": engine, "run.fuse_rounds": fuse,
+        "data.store.dir": store_dir, "data.placement": placement,
+    })
+    exp, p_store = _fit_params(cfg)
+    if placement == "stream":
+        assert exp.train_x is None  # the corpus never uploads wholesale
+    for a, b in zip(jax.tree.leaves(p_mem), jax.tree.leaves(p_store)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # eval runs off the store's bounded test split
+    ev = exp.evaluate(p_store)
+    assert 0.0 <= ev["eval_acc"] <= 1.0
+
+
+def test_materialized_twin_matches_streaming_run(store_dir):
+    """data.store.materialize=true is the in-memory twin switch the
+    scale smoke leans on: same store, classic in-RAM path, identical
+    params."""
+    cfg = _data_cfg()
+    cfg.apply_overrides({
+        "data.store.dir": store_dir, "data.placement": "stream",
+    })
+    _, p_stream = _fit_params(cfg)
+    cfg = _data_cfg()
+    cfg.apply_overrides({
+        "data.store.dir": store_dir, "data.store.materialize": True,
+    })
+    exp, p_mat = _fit_params(cfg)
+    assert isinstance(exp.fed.train_x, np.ndarray)
+    for a, b in zip(jax.tree.leaves(p_stream), jax.tree.leaves(p_mat)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_store_cli_build_info_and_fit(tmp_path, capsys):
+    out = str(tmp_path / "cli_store")
+    rc = cli.main([
+        "store", "build", "--out", out, "--config", "mnist_fedavg_2",
+        "--set", "data.num_clients=4", "--set",
+        "data.synthetic_train_size=128", "--set",
+        "data.synthetic_test_size=32",
+    ])
+    assert rc == 0
+    desc = json.loads(capsys.readouterr().out)
+    assert desc["num_clients"] == 4 and desc["num_examples"] == 128
+    assert cli.main(["store", "info", out]) == 0
+    assert json.loads(capsys.readouterr().out)["num_clients"] == 4
+    # a store-backed fit straight through the CLI
+    rc = cli.main([
+        "fit", "--config", "mnist_fedavg_2", "--out-dir", "",
+        "--set", f"data.store.dir={out}", "--set", "data.num_clients=4",
+        "--set", "data.placement=stream", "--set", "server.num_rounds=2",
+        "--set", "server.cohort_size=2", "--set", "server.eval_every=0",
+    ])
+    assert rc == 0
+    done = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert done["rounds"] == 2
+    # errors are clean exit-2s, not tracebacks
+    assert cli.main(["store", "info", str(tmp_path / "nope")]) == 2
+    assert cli.main(["store", "build", "--out", out]) == 2
+    err = capsys.readouterr().err
+    assert "Traceback" not in err
+
+
+def test_synthetic_builder_rejects_nonsense(tmp_path):
+    with pytest.raises(ValueError, match="examples_per_client"):
+        build_synthetic_store(str(tmp_path / "x"), num_clients=4,
+                              examples_per_client=0)
+
+
+# ---------------------------------------------------------------------------
+# streaming LEAF → store conversion (one json file resident at a time)
+# ---------------------------------------------------------------------------
+
+
+def _write_femnist_files(root, users_per_file=(3, 2), per_user=12, seed=0):
+    d = root / "femnist"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(seed)
+    uid = 0
+    for fi, n_users in enumerate(users_per_file):
+        users = [f"writer_{uid + i}" for i in range(n_users)]
+        uid += n_users
+        blob = {
+            "users": users,
+            "num_samples": [per_user] * n_users,
+            "user_data": {
+                u: {
+                    "x": rng.uniform(0, 1, (per_user, 784)).round(3).tolist(),
+                    "y": rng.integers(0, 62, per_user).tolist(),
+                }
+                for u in users
+            },
+        }
+        (d / f"all_data_{fi}.json").write_text(json.dumps(blob))
+    return root
+
+
+def test_femnist_streaming_store_matches_in_memory_loader(tmp_path):
+    """write_femnist_store streams one json FILE at a time but must
+    land exactly the bytes the in-memory loader path produces: same
+    per-writer train/test split (same rng stream), same record order."""
+    from colearn_federated_learning_tpu.data.leaf import load_femnist
+    from colearn_federated_learning_tpu.data.store import (
+        write_femnist_store,
+    )
+
+    data_dir = str(_write_femnist_files(tmp_path / "leaf"))
+    out = write_femnist_store(data_dir, str(tmp_path / "st"), seed=0)
+    st = open_store(out)
+    tx, ty, ex, ey, meta = load_femnist(data_dir, seed=0)
+    assert st.num_clients == 5  # one writer per client, across 2 files
+    np.testing.assert_array_equal(
+        st.counts, [len(g) for g in meta["natural_groups"]]
+    )
+    # the loader concatenates writers' train rows in the same stream
+    # order the converter writes them — whole-corpus byte parity
+    np.testing.assert_array_equal(np.asarray(st.x), tx)
+    np.testing.assert_array_equal(np.asarray(st.y), ty)
+    np.testing.assert_array_equal(st.test_x, ex)
+    np.testing.assert_array_equal(st.test_y, ey)
+    assert st.describe()["source"] == "store(leaf_femnist)"
+
+
+def test_leaf_stream_iterator_rejects_split_users(tmp_path):
+    from colearn_federated_learning_tpu.data.leaf import iter_leaf_clients
+
+    root = _write_femnist_files(tmp_path / "leaf", users_per_file=(2,))
+    dup = json.loads((root / "femnist" / "all_data_0.json").read_text())
+    (root / "femnist" / "all_data_1.json").write_text(json.dumps(dup))
+    with pytest.raises(ValueError, match="multiple"):
+        list(iter_leaf_clients(str(root / "femnist")))
