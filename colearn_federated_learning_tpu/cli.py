@@ -7,6 +7,11 @@ Entry points with capability parity to the reference's
     colearn evaluate --config cifar10_fedavg_100
     colearn configs            # list the named BASELINE configs
     colearn summarize <run>    # per-phase timing table from a run's JSONL
+    colearn watch <run>        # live tail of a run (mid-fit or done):
+                               # rounds/sec, loss, health, coverage,
+                               # pager hit rate, phase sparklines
+    colearn population <run>   # post-hoc federation health report
+                               # (population_health JSONL records)
     colearn clients <run>      # per-client forensic ledger report
                                # (anomalies + attack precision/recall)
     colearn mfu <run>          # MFU waterfall + roofline attribution
@@ -141,8 +146,15 @@ def build_parser():
     sb.add_argument("--shard-mb", type=int, default=64,
                     help="approximate shard file size; shards only "
                          "split between clients")
-    si = st_sub.add_parser("info", help="describe an existing store")
+    si = st_sub.add_parser(
+        "info",
+        help="describe an existing store: schema, size facts, and the "
+             "per-shard breakdown (examples / whole clients / bytes)",
+    )
     si.add_argument("dir", metavar="DIR")
+    si.add_argument("--json", action="store_true",
+                    help="emit the description as one JSON object "
+                         "instead of the table")
 
     sm = sub.add_parser(
         "summarize",
@@ -183,6 +195,43 @@ def build_parser():
                          "several min-flag-rate cutoffs (requires an "
                          "attack run), so the detection threshold can "
                          "be picked without re-running")
+
+    wa = sub.add_parser(
+        "watch",
+        help="live view of a run from its metrics JSONL (pure host — "
+             "no backend init, works mid-fit and on completed runs): "
+             "rounds/sec, loss, health/divergence state, pager hit "
+             "rate, coverage %%, phase-ms sparklines; refreshes until "
+             "the run completes",
+    )
+    wa.add_argument("run", metavar="RUN",
+                    help="run name (looked up under --out-dir), a run "
+                         "directory, or a .metrics.jsonl path")
+    wa.add_argument("--out-dir", default="runs",
+                    help="where <RUN>.metrics.jsonl lives (default: runs)")
+    wa.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between refreshes (default: 2)")
+    wa.add_argument("--json", action="store_true",
+                    help="one-shot mode for scripting: emit the current "
+                         "snapshot as one JSON object and exit")
+    wa.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no follow loop)")
+
+    po = sub.add_parser(
+        "population",
+        help="post-hoc federation health report from a run's "
+             "population_health JSONL records (run.obs.population): "
+             "coverage, draw split, staleness, ledger-pager and store "
+             "I/O health, participation fairness (no backend needed)",
+    )
+    po.add_argument("run", metavar="RUN",
+                    help="run name (looked up under --out-dir), a run "
+                         "directory, or a .metrics.jsonl path")
+    po.add_argument("--out-dir", default="runs",
+                    help="where <RUN>.metrics.jsonl lives (default: runs)")
+    po.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object instead of "
+                         "the table")
 
     mf = sub.add_parser(
         "mfu",
@@ -236,10 +285,14 @@ def main(argv=None):
 
         if args.store_cmd == "info":
             try:
-                print(json.dumps(store_mod.open_store(args.dir).describe()))
+                info = store_mod.open_store(args.dir).describe()
             except (FileNotFoundError, ValueError) as e:
                 print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
                 return 2
+            if args.json:
+                print(json.dumps(info))
+            else:
+                print(store_mod.format_store_info(info))
             return 0
         # build: exactly one source
         sources = [args.config, args.synthetic_clients, args.leaf_femnist]
@@ -321,7 +374,7 @@ def main(argv=None):
         # a tripped gate is the whole point: non-zero, naming the phase
         return 1 if report["violations"] else 0
 
-    if args.cmd in ("summarize", "clients", "mfu"):
+    if args.cmd in ("summarize", "clients", "mfu", "watch", "population"):
         # pure-host JSONL aggregation — runs before (and without) any
         # jax backend initialization
         from colearn_federated_learning_tpu.obs import summary as obs_summary
@@ -334,9 +387,39 @@ def main(argv=None):
         records = obs_summary.load_records(path)
         if not records:
             # an empty (or torn-to-nothing) log gets a clean error, not
-            # a zero-row table or a traceback
+            # a zero-row table or a traceback — watch included (the
+            # live tailer shares summarize's empty/missing contract)
             print(f"error: no metrics records in {path}", file=sys.stderr)
             return 2
+        if args.cmd == "watch":
+            from colearn_federated_learning_tpu.obs import (
+                population as obs_population,
+            )
+
+            if args.json or args.once:
+                snap = obs_population.watch_snapshot(records)
+                if args.json:
+                    print(json.dumps(dict(snap, path=path)))
+                else:
+                    print(obs_population.format_watch(snap, path))
+                return 0
+            return obs_population.watch_follow(path, interval=args.interval)
+        if args.cmd == "population":
+            from colearn_federated_learning_tpu.obs import (
+                population as obs_population,
+            )
+
+            try:
+                report = obs_population.population_report(records)
+            except ValueError as e:
+                print(f"error: {e.args[0] if e.args else e}",
+                      file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(dict(report, path=path)))
+            else:
+                print(obs_population.format_population_report(report, path))
+            return 0
         if args.cmd == "mfu":
             from colearn_federated_learning_tpu.obs import roofline
 

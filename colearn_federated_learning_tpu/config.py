@@ -577,6 +577,41 @@ class ClientLedgerConfig:
 
 
 @dataclass
+class PopulationConfig:
+    """Federation health observatory (``run.obs.population``,
+    obs/population.py): per-metrics-flush-window ``population_health``
+    JSONL records covering the data plane the million-client structures
+    run on — sampler health (cumulative unique-client coverage via an
+    O(1)-memory HLL-style probabilistic counter, exploration/
+    exploitation draw split, streaming-sketch occupancy/refresh-age/
+    flag-rate coverage, cohort staleness distribution), ledger-pager
+    health (per-window hit/miss/page-in/eviction counts + page-sync
+    stall ms — the PR 9 run_summary totals as a time series), store I/O
+    (bytes gathered, gather wall ms, per-shard touch counts, union-slab
+    dedup ratio), and participation fairness (Gini/max-share over a
+    bounded top-k participation sketch — never a dense [num_clients]
+    histogram). Every tracked structure is O(cohort) per round or
+    fixed-size, and every count-based column is a pure function of the
+    host-side cohort schedule, so records are engine-parity pinned
+    (sharded ≡ sequential ≡ fused) on everything but the ``*_ms``
+    wall-clock fields. Purely observational: no device work, no rng
+    consumption, params bitwise-unchanged. ``colearn watch <run>``
+    renders the live view; ``colearn population <run>`` is the post-hoc
+    report; ``colearn summarize`` surfaces the run_summary totals."""
+
+    enabled: bool = False
+    # capacity of the bounded top-k participation sketch the fairness
+    # stats (gini, max-share, top clients) are computed over
+    top_k: int = 64
+    # HLL register count = 2**hll_bits (12 → 4096 one-byte registers,
+    # ~1.6% relative error on the coverage estimate)
+    hll_bits: int = 12
+    # bounded last-participation map behind the staleness distribution;
+    # cohort members evicted from it count as staleness-unknown
+    recency_capacity: int = 8192
+
+
+@dataclass
 class ObsConfig:
     """Round-lifecycle telemetry (``obs/``): phase spans, comm/device
     counters, and run-health monitoring — the observability layer every
@@ -638,6 +673,8 @@ class ObsConfig:
     client_ledger: ClientLedgerConfig = field(
         default_factory=ClientLedgerConfig
     )
+    # Federation health observatory — see PopulationConfig.
+    population: PopulationConfig = field(default_factory=PopulationConfig)
 
 
 @dataclass
@@ -1505,6 +1542,21 @@ class ExperimentConfig:
                 f"unknown run.obs.phase_cost_flops "
                 f"{obs.phase_cost_flops!r}; expected 'analytic' or 'xla'"
             )
+        pop = obs.population
+        if not 4 <= pop.hll_bits <= 18:
+            raise ValueError(
+                f"run.obs.population.hll_bits must be in [4, 18], "
+                f"got {pop.hll_bits}"
+            )
+        if pop.top_k < 1:
+            raise ValueError(
+                f"run.obs.population.top_k must be >= 1, got {pop.top_k}"
+            )
+        if pop.recency_capacity < 1:
+            raise ValueError(
+                f"run.obs.population.recency_capacity must be >= 1, "
+                f"got {pop.recency_capacity}"
+            )
         cl = obs.client_ledger
         if not 0.0 < cl.ema <= 1.0:
             raise ValueError(
@@ -1748,6 +1800,7 @@ class ExperimentConfig:
             "obs": ObsConfig,  # nested under run
             "shape_buckets": ShapeBucketsConfig,  # nested under run
             "client_ledger": ClientLedgerConfig,  # nested under run.obs
+            "population": PopulationConfig,  # nested under run.obs
             "reputation": ReputationConfig,  # nested under server
             "adaptive": AdaptiveSamplerConfig,  # nested under server
             "store": StoreConfig,  # nested under data

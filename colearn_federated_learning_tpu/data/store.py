@@ -52,6 +52,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -94,6 +96,17 @@ class ShardedRecordArray:
         self.dtype = np.dtype(dtype)
         self.shape = (int(self._bounds[-1]),) + self._rec_shape
         self._maps: List[Optional[np.memmap]] = [None] * len(self._paths)
+        # gather-I/O accounting (obs/population.py store-health plane):
+        # calls / rows / bytes copied out of the mmaps, wall ms, and a
+        # fixed-size per-shard touch histogram. Gathers run on the fit
+        # thread AND the prefetch worker, so updates take the lock; the
+        # counts are a pure function of which slabs were built (engine-
+        # independent), ms is wall clock.
+        self._stats_lock = threading.Lock()
+        self._gather_calls = 0
+        self._gather_rows = 0
+        self._gather_ms = 0.0
+        self._shard_touches = np.zeros(len(self._paths), np.int64)
 
     # ---- ndarray-protocol surface -----------------------------------
 
@@ -129,12 +142,35 @@ class ShardedRecordArray:
             raise IndexError(
                 f"store gather ids out of range [0, {len(self)})"
             )
+        t0 = time.perf_counter()
         out = np.empty((len(ids),) + self._rec_shape, self.dtype)
         shard = np.searchsorted(self._bounds, ids, side="right") - 1
-        for s in np.unique(shard):
+        touched = np.unique(shard)
+        for s in touched:
             sel = shard == s
             out[sel] = self._map(int(s))[ids[sel] - self._bounds[s]]
+        with self._stats_lock:
+            self._gather_calls += 1
+            self._gather_rows += len(ids)
+            self._gather_ms += (time.perf_counter() - t0) * 1000.0
+            if touched.size:
+                self._shard_touches[touched] += 1
         return out
+
+    def gather_stats(self) -> Dict[str, Any]:
+        """Cumulative gather-I/O counters (population-health store
+        plane): calls, rows/bytes copied, wall ms, per-shard touch
+        counts. The caller (PopulationTracker) deltas consecutive
+        snapshots into per-window numbers."""
+        rec_bytes = int(np.prod(self._rec_shape)) * self.itemsize
+        with self._stats_lock:
+            return {
+                "calls": int(self._gather_calls),
+                "rows": int(self._gather_rows),
+                "bytes": int(self._gather_rows) * rec_bytes,
+                "ms": float(self._gather_ms),
+                "shard_touches": self._shard_touches.copy(),
+            }
 
     def __getitem__(self, key):
         if isinstance(key, (int, np.integer)):
@@ -484,8 +520,32 @@ class ClientStore:
         )
 
     def describe(self) -> Dict[str, Any]:
-        """`colearn store info`'s payload: schema + size facts."""
+        """`colearn store info`'s payload: schema + size facts, plus the
+        per-shard breakdown (examples, whole clients resident, x/y
+        bytes) — clients never span shards, so each client belongs to
+        exactly one shard row here."""
         data_bytes = self.x.nbytes + self.y.nbytes
+        shard_examples = [int(c) for c in self.meta["shard_examples"]]
+        # client c's records start at global example offset starts[c];
+        # the shard holding that offset holds the WHOLE client
+        starts = np.concatenate([[0], np.cumsum(self.counts)])[:-1]
+        bounds = np.concatenate([[0], np.cumsum(shard_examples)])
+        owner = np.searchsorted(bounds, starts, side="right") - 1
+        x_rec = int(np.prod(self.meta["x_shape"] or [1])) * np.dtype(
+            self.meta["x_dtype"]
+        ).itemsize
+        y_rec = int(np.prod(self.meta["y_shape"] or [1])) * np.dtype(
+            self.meta["y_dtype"]
+        ).itemsize
+        shards = []
+        for i, n in enumerate(shard_examples):
+            shards.append({
+                "shard": i,
+                "examples": n,
+                "clients": int(np.count_nonzero(owner == i)),
+                "x_mb": round(n * x_rec / 2**20, 2),
+                "y_mb": round(n * y_rec / 2**20, 2),
+            })
         return {
             "dir": self.dir,
             "num_clients": self.num_clients,
@@ -497,11 +557,40 @@ class ClientStore:
             "source": self.meta.get("source"),
             "x_shape": list(self.meta["x_shape"]),
             "x_dtype": self.meta["x_dtype"],
-            "num_shards": len(self.meta["shard_examples"]),
+            "num_shards": len(shard_examples),
             "data_mb": round(data_bytes / 2**20, 2),
             "test_examples": int(self.meta.get("test_examples", 0)),
+            "shards": shards,
         }
 
 
 def open_store(store_dir: str) -> ClientStore:
     return ClientStore(store_dir)
+
+
+def format_store_info(info: Dict[str, Any]) -> str:
+    """Render :meth:`ClientStore.describe` as an aligned text table
+    (``colearn store info`` without ``--json``)."""
+    lines = [
+        f"store: {info['dir']}",
+        f"clients: {info['num_clients']}  examples: {info['num_examples']} "
+        f"({info['examples_per_client_min']}-"
+        f"{info['examples_per_client_max']} per client)  classes: "
+        f"{info['num_classes']}  task: {info.get('task')}",
+        f"x: {info['x_shape']} {info['x_dtype']}  data: "
+        f"{info['data_mb']} MB  test examples: {info['test_examples']}  "
+        f"source: {info.get('source')}",
+    ]
+    shards = info.get("shards") or []
+    if shards:
+        lines.append("")
+        lines.append(
+            f"{'shard':>6}{'examples':>12}{'clients':>10}{'x MB':>10}"
+            f"{'y MB':>10}"
+        )
+        for s in shards:
+            lines.append(
+                f"{s['shard']:>6}{s['examples']:>12}{s['clients']:>10}"
+                f"{s['x_mb']:>10.2f}{s['y_mb']:>10.2f}"
+            )
+    return "\n".join(lines)

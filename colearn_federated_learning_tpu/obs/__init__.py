@@ -22,6 +22,14 @@ Three pillars, each its own module, all host-side and engine-agnostic:
   that decomposes headline MFU into padding/host/non-matmul/residual
   components, and the ``colearn bench-report`` trajectory gates over
   ``BENCH_r*.json`` + the checked-in ``BENCH_BUDGETS.json``.
+- :mod:`population` — the federation health observatory
+  (``run.obs.population``): population/data-plane telemetry for the
+  million-client structures — HLL-style unique-client coverage,
+  exploration/exploitation draw split, cohort staleness, ledger-pager
+  and store-I/O health, participation fairness — as per-flush-window
+  ``population_health`` records (count columns engine-parity pinned),
+  plus the pure-host ``colearn watch`` live tailer and ``colearn
+  population`` report.
 
 Everything is configured through the ``run.obs`` config block
 (:class:`~colearn_federated_learning_tpu.config.ObsConfig`); the
@@ -46,6 +54,11 @@ from colearn_federated_learning_tpu.obs.ledger import (  # noqa: F401
     STAT_COLS,
     client_round_stats,
     update_ledger,
+)
+from colearn_federated_learning_tpu.obs.population import (  # noqa: F401
+    HLLCounter,
+    PopulationTracker,
+    SpaceSavingSketch,
 )
 from colearn_federated_learning_tpu.obs.roofline import (  # noqa: F401
     PEAK_BF16_FLOPS,

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -252,6 +253,16 @@ class LedgerPager:
         self._client_slot: Dict[int, int] = {}
         self.evictions = 0
         self.page_syncs = 0
+        # population-health counters (obs/population.py): cohort
+        # members already hot-resident at assign time vs page-ins, and
+        # the cumulative wall time the blocking eviction write-backs
+        # stalled the round loop. Counts are pure functions of the
+        # cohort schedule (engine-parity material); sync_ms is wall
+        # clock and excluded from the parity pin.
+        self.hits = 0
+        self.misses = 0
+        self.page_ins = 0
+        self.sync_ms = 0.0
 
     # ---- persistence (rides the driver's checkpoint state) -----------
 
@@ -299,14 +310,19 @@ class LedgerPager:
         ids = np.asarray(cohort_ids, np.int64)
         real = np.unique(ids[(ids >= 0) & (ids < self.num_clients)])
         missing = [int(c) for c in real if int(c) not in self._client_slot]
+        self.hits += len(real) - len(missing)
+        self.misses += len(missing)
+        self.page_ins += len(missing)
         free = np.flatnonzero(self.slot_clients < 0)
         if len(missing) > len(free):
             protected = {
                 self._client_slot[int(c)] for c in real
                 if int(c) in self._client_slot
             }
+            t0 = time.perf_counter()
             hot = np.asarray(fetch_hot())
             self.write_back(hot)
+            self.sync_ms += (time.perf_counter() - t0) * 1000.0
             self.page_syncs += 1
             occupied = np.flatnonzero(self.slot_clients >= 0)
             victims = [s for s in occupied if s not in protected]

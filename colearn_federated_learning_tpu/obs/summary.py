@@ -145,6 +145,28 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         counters = {
             k: int(run_sum[k]) for k in _COUNTER_KEYS if k in run_sum
         }
+        # paged-ledger accounting (PR 9 recorded these; now rendered):
+        # evictions are cold spills, page_syncs the blocking hot-set
+        # fetches they forced
+        paging = {
+            k: int(run_sum[k])
+            for k in ("ledger_evictions", "ledger_page_syncs")
+            if k in run_sum
+        }
+        if paging:
+            out["ledger_paging"] = paging
+        # population totals (run.obs.population): lifetime coverage /
+        # participation, overall pager hit rate, store gather bytes
+        population = {
+            k: run_sum[k]
+            for k in ("population_unique_clients",
+                      "population_coverage_pct",
+                      "population_participations", "pager_hit_rate",
+                      "store_gather_bytes")
+            if k in run_sum
+        }
+        if population:
+            out["population"] = population
     if counters:
         out["comm"] = counters
     if dropped or stragglers or byzantine:
@@ -234,6 +256,34 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
             f"{_fmt_bytes(comm.get('download_bytes', 0))} wire / "
             f"{_fmt_bytes(comm.get('download_bytes_raw', 0))} raw"
         )
+    paging = summary.get("ledger_paging")
+    if paging:
+        lines.append(
+            f"ledger paging: {paging.get('ledger_evictions', 0)} "
+            f"evictions, {paging.get('ledger_page_syncs', 0)} page syncs"
+        )
+    pop = summary.get("population")
+    if pop:
+        bits = []
+        if "population_unique_clients" in pop:
+            bits.append(
+                f"{pop['population_unique_clients']} unique clients"
+                + (f" ({pop['population_coverage_pct']:.1f}% coverage)"
+                   if "population_coverage_pct" in pop else "")
+            )
+        if "population_participations" in pop:
+            bits.append(
+                f"{pop['population_participations']} participations"
+            )
+        if "pager_hit_rate" in pop:
+            bits.append(
+                f"pager hit rate {100.0 * pop['pager_hit_rate']:.1f}%"
+            )
+        if "store_gather_bytes" in pop:
+            bits.append(
+                f"store gathered {_fmt_bytes(pop['store_gather_bytes'])}"
+            )
+        lines.append("population: " + "  ".join(bits))
     fails = summary.get("failures")
     if fails:
         lines.append(

@@ -692,6 +692,26 @@ class Experiment:
         )
         self._phase_costs: Dict[int, Dict[str, Dict[str, int]]] = {}
         self._step_flops_cache = None
+        # Federation health observatory (run.obs.population, obs/
+        # population.py): population/data-plane telemetry — coverage,
+        # draw split, staleness, pager/store health, fairness — folded
+        # into one `population_health` record per flush window. Purely
+        # observational host-side accounting: no device work, no rng
+        # consumption, and every count-based column is a pure function
+        # of the cohort schedule, so records are engine-parity pinned
+        # (the `*_ms` wall-clock fields are the one exception).
+        self._population = None
+        if obs.population.enabled:
+            from colearn_federated_learning_tpu.obs.population import (
+                PopulationTracker,
+            )
+
+            self._population = PopulationTracker(
+                self.fed.num_clients,
+                top_k=obs.population.top_k,
+                hll_bits=obs.population.hll_bits,
+                recency_capacity=obs.population.recency_capacity,
+            )
 
         # Host-side round-input construction: the C++ threaded pipeline
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
@@ -1608,6 +1628,16 @@ class Experiment:
                 cohort, idx, mask, n_ex, slab = self._host_inputs(
                     round_idx, shape=shape, build_slab=place,
                 )
+        if self._population is not None and slab is not None:
+            # stream-slab dedup shape, observed at CONSUMPTION (not in
+            # _stream_slab, which may also run for prefetch entries the
+            # consumer drops): the remapped index tensor's max + 1 IS
+            # the unique-row count the gather copied
+            sl_idx = slab[0]
+            self._population.observe_slab(
+                int(sl_idx.size),
+                int(sl_idx.max()) + 1 if sl_idx.size else 0,
+            )
         self._maybe_prefetch(round_idx)
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._counters_on:
@@ -1725,6 +1755,11 @@ class Experiment:
                                               shape=self.shape)
         if self._counters_on:
             self._comm_stats[round_idx] = self._round_comm(cohort, n_ex)
+        if self._population is not None:
+            # fedbuff pops its in-flight queue rather than sampling, so
+            # there is no draw-provenance split — coverage/fairness/
+            # staleness still track the realized server steps
+            self._population.observe_cohort(round_idx, cohort, n_ex, None)
         base_w = (
             n_ex if self._agg_mode == "examples"
             else (n_ex > 0).astype(np.float32)
@@ -1848,6 +1883,11 @@ class Experiment:
             round_fn = self._unfused_round_fn()
         (cohort, idx, mask, n_ex, train_x, train_y,
          n_host) = self._round_inputs(round_idx)
+        if self._population is not None:
+            self._population.observe_cohort(
+                round_idx, cohort, n_host,
+                self.sampler.take_draw_stats(round_idx),
+            )
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         # Byzantine mask for this round's cohort: which sampled slots
         # the adversary owns. An ARRAY input alongside n_ex (no
@@ -2042,6 +2082,11 @@ class Experiment:
             (c_j, i_j, m_j, n_j, train_x, train_y,
              _) = self._round_inputs(round_idx + j, place=False,
                                      shape=chunk_shape)
+            if self._population is not None:
+                self._population.observe_cohort(
+                    round_idx + j, c_j, n_j,
+                    self.sampler.take_draw_stats(round_idx + j),
+                )
             idxs.append(i_j)
             masks.append(m_j)
             n_exs.append(n_j)
@@ -2067,6 +2112,12 @@ class Experiment:
                     uniq, inv = np.unique(idx_stack, return_inverse=True)
                     rows = self._fused_slab_rows
                     assert len(uniq) <= rows, (len(uniq), rows)
+                    if self._population is not None:
+                        # union-slab dedup under fuse: the whole chunk's
+                        # grid slots vs the one slab actually gathered
+                        self._population.observe_slab(
+                            int(idx_stack.size), int(len(uniq))
+                        )
                     slab_x = np.empty(
                         (rows,) + self.fed.train_x.shape[1:],
                         self.fed.train_x.dtype,
@@ -2351,9 +2402,18 @@ class Experiment:
             self.sampler.observe_snapshot(dense, round_idx)
             return
         m = len(self._sketch_ids)
+        total_flagged = float(cols[:, 1].sum())
         if len(ids) > m:
             keep = np.sort(np.lexsort((ids, -cols[:, 0]))[:m])
             ids, cols = ids[keep], cols[keep]
+        if self._population is not None:
+            # sketch-vs-universe flag coverage: how much of the
+            # ledger's flagged (attacker-evidence) mass the retained
+            # sketch rows carry — the number that says whether the
+            # streaming sampler can SEE the attacker population
+            self._population.observe_sketch_refresh(
+                total_flagged, float(cols[:, 1].sum())
+            )
         self._sketch_ids = np.full(m, -1, np.int32)
         self._sketch_ids[: len(ids)] = ids
         self._sketch_stats = np.zeros((m, 3), np.float32)
@@ -2367,6 +2427,29 @@ class Experiment:
             } if len(ids) else None,
             round_idx,
         )
+
+    def _log_population(self, last_round: int) -> None:
+        """Fold the population tracker's window into one
+        ``population_health`` JSONL record (no-op when tracking is off
+        or the window saw no rounds — tail flushes stay silent)."""
+        if self._population is None:
+            return
+        store_arrays = [
+            a for a in (self.fed.train_x, self.fed.train_y)
+            if hasattr(a, "gather_stats")
+        ]
+        sketch_ids = refresh_age = None
+        if self._streaming and self._snapshot_refresh:
+            sketch_ids = self._sketch_ids
+            refresh_age = max(
+                0, int(last_round) - int(self._sampler_snapshot_round)
+            )
+        rec = self._population.window_record(
+            last_round, pager=self._pager, store_arrays=store_arrays,
+            sketch_ids=sketch_ids, refresh_age=refresh_age,
+        )
+        if rec is not None:
+            self.logger.log(rec)
 
     def _seed_sampler_from_state(self, state: Dict[str, Any]) -> None:
         """Feed the sampler the checkpoint's ACTIVE snapshot (adaptive)
@@ -2517,6 +2600,13 @@ class Experiment:
                         "ledger_evictions": int(self._pager.evictions),
                         "ledger_page_syncs": int(self._pager.page_syncs),
                     } if self._pager is not None else {}),
+                    # population totals (run.obs.population): lifetime
+                    # coverage / participation / pager hit rate / store
+                    # bytes — `colearn summarize` renders these
+                    **(self._population.summary_totals(
+                        self._pager,
+                        (self.fed.train_x, self.fed.train_y),
+                    ) if self._population is not None else {}),
                 })
             except Exception as e:
                 print(f"run_summary log failed: {e}", flush=True)
@@ -2739,6 +2829,7 @@ class Experiment:
                     self.logger.log(
                         {"event": "device_memory", "round": last_round, **mem}
                     )
+            self._log_population(last_round)
 
         def unhealthy(events, current_state):
             """Apply the configured on_unhealthy policy to this window's

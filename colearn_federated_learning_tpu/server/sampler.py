@@ -82,6 +82,16 @@ class CohortSampler:
         # streaming keeps the columnar sketch instead
         self.snapshot_round: int = 0
         self._sketch: Optional[Dict[str, np.ndarray]] = None
+        # Draw-provenance tally (obs/population.py sampler-health
+        # plane): which pool each round's accepted draws came from —
+        # {explore, scored, unseen, backstop, uniform}. Purely
+        # observational (tallied AFTER the rng consumption the draw
+        # already did, so schedules are bitwise-unchanged); keyed by
+        # round because the prefetch/native paths sample ahead, bounded
+        # so an unconsumed tail can never grow with the run. Repeat
+        # sample() calls for the same round (native lookahead) overwrite
+        # with identical values — draws are pure in (seed, round, state).
+        self._draw_stats: Dict[int, Dict[str, int]] = {}
         if weights is not None:
             if mode in ("poisson", "adaptive", "streaming"):
                 raise ValueError(
@@ -264,8 +274,13 @@ class CohortSampler:
         (binary search over the score cumsum), or the unseen pool
         (uniform with seen-ids rejection) — duplicates rejected, so the
         cohort is without replacement like the dense modes. No dense
-        [num_clients] structure is ever built."""
+        [num_clients] structure is ever built. Accepted draws are
+        tallied by pool into ``self._last_draws`` (the population
+        tracker's exploration/exploitation split) — observation only,
+        the rng stream is exactly the pre-tally stream."""
         n, k = self.num_clients, self.cohort_size
+        draws = {"explore": 0, "scored": 0, "unseen": 0}
+        self._last_draws = draws
         out: set = set()
         sk = self._sketch
         if sk is None:
@@ -287,22 +302,41 @@ class CohortSampler:
             budget -= 1
             if total <= 0.0 or rng.random() < self.explore:
                 cand = int(rng.integers(n))  # exploration floor: uniform
+                pool = "explore"
             else:
                 v = rng.random() * total
                 if v < total_obs:
                     cand = int(ids[int(np.searchsorted(cum, v, side="right"))])
+                    pool = "scored"
                 else:
                     cand = int(rng.integers(n))  # unseen pool
+                    pool = "unseen"
                     if cand in id_set:
                         continue  # landed on a seen id: not this pool's
             if cand in out:
                 continue
             out.add(cand)
+            draws[pool] += 1
         if len(out) < k:
+            draws["backstop"] = k - len(out)
             self._fill_deterministic(out)
         return np.sort(np.fromiter(out, np.int64, len(out)))
 
     # ------------------------------------------------------------------
+
+    def _note_draws(self, round_idx: int, counts: Dict[str, int]) -> None:
+        self._draw_stats[int(round_idx)] = {
+            k: v for k, v in counts.items() if v
+        }
+        if len(self._draw_stats) > 128:
+            # an unconsumed tail (population tracking off, or prefetch
+            # sampling far ahead) must stay bounded
+            self._draw_stats.pop(min(self._draw_stats))
+
+    def take_draw_stats(self, round_idx: int) -> Optional[Dict[str, int]]:
+        """Pop the draw-provenance tally for one round (None when that
+        round was never sampled, e.g. fedbuff's queue scheduler)."""
+        return self._draw_stats.pop(int(round_idx), None)
 
     def sample(self, round_idx: int) -> np.ndarray:
         rng = np.random.default_rng((self.seed, round_idx))
@@ -312,10 +346,21 @@ class CohortSampler:
             # EXACT. Realized size is Binomial(N, q); the driver pads to
             # its static cap. A zero-participant round is legitimate
             # (the engine's degenerate-denominator path handles it).
-            return np.flatnonzero(rng.random(self.num_clients) < self.q)
+            out = np.flatnonzero(rng.random(self.num_clients) < self.q)
+            self._note_draws(round_idx, {"uniform": len(out)})
+            return out
         if self.mode == "streaming":
-            return self._sample_streaming(rng)
-        return np.sort(
+            out = self._sample_streaming(rng)
+            self._note_draws(round_idx, self._last_draws)
+            return out
+        out = np.sort(
             rng.choice(self.num_clients, size=self.cohort_size,
                        replace=False, p=self.probs)
         )
+        # dense modes draw all slots from one distribution: "scored"
+        # when ledger/static weights shaped it (adaptive past the first
+        # snapshot, mode="weighted"), the uniform prior otherwise
+        self._note_draws(round_idx, {
+            ("scored" if self.probs is not None else "uniform"): len(out)
+        })
+        return out

@@ -107,6 +107,27 @@ _SECTIONS = [
      "channel), gossip/fedbuff (no synchronous cohort upload stack), "
      "scaffold/feddyn (stateful store plumbing). See docs/DESIGN.md "
      "\"Client ledger & attack attribution\"."),
+    ("run.obs.population", config_mod.PopulationConfig,
+     "Federation health observatory (obs/population.py): per-flush-"
+     "window `population_health` JSONL records covering the data "
+     "plane the million-client structures run on — sampler health "
+     "(cumulative unique-client coverage via a seed-pure O(1)-memory "
+     "HLL-style counter, exploration/exploitation draw split, "
+     "streaming-sketch occupancy / refresh age / flag-rate coverage, "
+     "cohort staleness distribution over a bounded recency map), "
+     "ledger-pager health (per-window hit/miss/page-in/eviction "
+     "counts + page-sync stall ms — the run_summary totals as a time "
+     "series), store I/O (bytes gathered, gather wall ms, per-shard "
+     "touch counts, union-slab dedup ratio), and participation "
+     "fairness (Gini/max-share over a bounded top-k sketch, never a "
+     "dense [num_clients] histogram). Every structure is O(cohort) or "
+     "fixed-size and every count-based column is engine-parity pinned "
+     "(sharded = sequential = fused; only `*_ms` wall-clock fields "
+     "may differ). Purely observational — params bitwise-unchanged. "
+     "`colearn watch <run>` renders the live view (pure host, works "
+     "mid-fit), `colearn population <run>` the post-hoc report; "
+     "`colearn summarize` surfaces the run_summary totals. See "
+     "docs/DESIGN.md \"Federation health observatory\"."),
 ]
 
 # appended under the `attack` section table (kept here so the generated

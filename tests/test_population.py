@@ -1,0 +1,569 @@
+"""Federation health observatory (obs/population.py,
+run.obs.population): probabilistic-counter / fairness-sketch units, the
+tracker's window-record semantics, engine/fusion parity of the
+count-based population_health columns on the krum × sign_flip shape,
+the pure-observability contract, the `colearn watch` live tailer
+(torn-line safety + the summarize exit-2 contract) and `colearn
+population` report, and the per-shard `colearn store info` upgrade."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import cli
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.obs.population import (
+    HLLCounter,
+    PopulationTracker,
+    SpaceSavingSketch,
+    format_population_report,
+    format_watch,
+    population_report,
+    read_complete_records,
+    sparkline,
+    strip_timing_keys,
+    watch_follow,
+    watch_snapshot,
+)
+
+# ---------------------------------------------------------------------------
+# units: the O(1)-memory structures
+# ---------------------------------------------------------------------------
+
+
+def test_hll_estimate_accuracy_and_determinism():
+    h = HLLCounter(bits=12)
+    ids = np.arange(10_000)
+    h.add(ids)
+    est = h.estimate()
+    # 4096 registers → ~1.6% standard error; 5% is a generous pin
+    assert abs(est - 10_000) / 10_000 < 0.05, est
+    # seed-pure: a second counter fed the same ids (any order, any
+    # chunking) lands the identical registers and estimate
+    h2 = HLLCounter(bits=12)
+    rng = np.random.default_rng(0)
+    for chunk in np.array_split(rng.permutation(ids), 7):
+        h2.add(chunk)
+    assert h2.estimate() == est
+    np.testing.assert_array_equal(h.registers, h2.registers)
+
+
+def test_hll_small_range_is_near_exact():
+    h = HLLCounter(bits=12)
+    h.add(np.arange(50))
+    assert abs(h.estimate() - 50) <= 2
+    # duplicates never move the estimate
+    h.add(np.arange(50))
+    assert abs(h.estimate() - 50) <= 2
+
+
+def test_hll_rejects_bad_bits():
+    with pytest.raises(ValueError):
+        HLLCounter(bits=2)
+
+
+def test_space_saving_keeps_heavy_hitters():
+    sk = SpaceSavingSketch(capacity=8)
+    rng = np.random.default_rng(1)
+    # two heavy clients among a stream of 200 light ones
+    stream = list(rng.integers(10, 200, size=400)) + [1] * 100 + [2] * 80
+    rng.shuffle(stream)
+    sk.add(stream)
+    top = [c for c, _ in sk.top(2)]
+    assert set(top) == {1, 2}
+    assert sk.total == len(stream)
+    assert len(sk.counts) <= 8
+    assert 0.0 <= sk.gini() <= 1.0
+    assert 0.0 < sk.max_share() <= 1.0
+
+
+def test_space_saving_uniform_gini_is_zero():
+    sk = SpaceSavingSketch(capacity=16)
+    for _ in range(5):
+        sk.add(np.arange(10))
+    assert sk.gini() == 0.0
+    assert sk.max_share() == pytest.approx(0.1)
+
+
+def test_tracker_window_record_and_reset():
+    tr = PopulationTracker(num_clients=100, top_k=8, hll_bits=12)
+    tr.observe_cohort(0, [1, 2, 3, 100], [5, 5, 5, 0],
+                      {"uniform": 3})  # pad id 100 excluded
+    tr.observe_cohort(1, [2, 3, 4], [5, 5, 0], {"uniform": 2})  # 4 dropped
+    tr.observe_slab(64, 48)
+    rec = tr.window_record(2)
+    assert rec["event"] == "population_health"
+    assert rec["window_rounds"] == 2 and rec["participants"] == 5
+    assert rec["draws"] == {"uniform": 5}
+    # unique participants: {1, 2, 3} — the pad (100) and the dropped
+    # client (4, n_ex 0) never count
+    assert rec["coverage"]["unique_clients_est"] == 3
+    assert rec["coverage"]["coverage_pct"] == 3.0
+    # clients 2 and 3 repeated one round apart
+    assert rec["staleness"]["known"] == 2
+    assert rec["staleness"]["mean"] == 1.0
+    assert rec["staleness"]["first_seen"] == 3
+    assert rec["store"]["slab_dedup_ratio"] == 0.75
+    assert rec["fairness"]["total_participations"] == 5
+    # the window resets; cumulative structures persist
+    assert tr.window_record(2) is None
+    tr.observe_cohort(5, [1], [5], None)
+    rec2 = tr.window_record(5)
+    assert rec2["window_rounds"] == 1 and rec2["participants"] == 1
+    assert rec2["fairness"]["total_participations"] == 6
+    assert rec2["staleness"]["known"] == 1  # client 1 last seen round 0
+    assert rec2["staleness"]["max"] == 5
+    totals = tr.summary_totals()
+    assert totals["population_unique_clients"] == 3
+    assert totals["population_participations"] == 6
+
+
+def test_strip_timing_keys_is_recursive():
+    obj = {"a": 1, "x_ms": 2.0,
+           "nested": {"gather_ms": 1.0, "rows": 3,
+                      "list": [{"sync_stall_ms": 9, "ok": 1}]}}
+    assert strip_timing_keys(obj) == {
+        "a": 1, "nested": {"rows": 3, "list": [{"ok": 1}]}
+    }
+
+
+# ---------------------------------------------------------------------------
+# the incremental tailer (`colearn watch`'s read path)
+# ---------------------------------------------------------------------------
+
+
+def test_read_complete_records_leaves_torn_tail(tmp_path):
+    path = tmp_path / "x.metrics.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"round": 1, "train_loss": 1.0}) + "\n")
+        f.write('{"round": 2, "train_l')  # torn mid-record, no newline
+    recs, off = read_complete_records(str(path), 0)
+    assert [r["round"] for r in recs] == [1]
+    # the torn tail was NOT consumed: completing the line later yields
+    # the whole record from the saved offset
+    with open(path, "a") as f:
+        f.write('oss": 0.5}\n')
+    recs2, off2 = read_complete_records(str(path), off)
+    assert [r["round"] for r in recs2] == [2]
+    assert recs2[0]["train_loss"] == 0.5
+    assert off2 > off
+    # nothing new → no records, offset unchanged
+    recs3, off3 = read_complete_records(str(path), off2)
+    assert recs3 == [] and off3 == off2
+
+
+def test_read_complete_records_skips_bad_terminated_line(tmp_path):
+    path = tmp_path / "x.metrics.jsonl"
+    with open(path, "w") as f:
+        f.write('{"round": 1}\n')
+        f.write("garbage not json\n")  # crash artifact: skipped
+        f.write('{"round": 2}\n')
+    recs, _ = read_complete_records(str(path), 0)
+    assert [r["round"] for r in recs] == [1, 2]
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert len(sparkline([1.0] * 5)) == 5
+    s = sparkline([0, 1, 2, 3])
+    assert s[0] == "▁" and s[-1] == "█"
+
+
+def test_watch_snapshot_running_vs_completed():
+    records = [
+        {"round": 1, "train_loss": 2.0},
+        {"event": "spans", "round": 2,
+         "phases": {"round": {"count": 2, "total_ms": 10.0, "max_ms": 6.0}}},
+        {"round": 2, "train_loss": 1.5, "rounds_per_sec": 3.0,
+         "eval_loss": 1.4, "eval_acc": 0.5},
+        {"event": "health", "kind": "divergence", "round": 2},
+        {"event": "population_health", "round": 2, "window_rounds": 2,
+         "participants": 8,
+         "coverage": {"unique_clients_est": 6, "coverage_pct": 75.0,
+                      "num_clients": 8},
+         "pager": {"hit_rate": 0.9, "hits": 9, "misses": 1}},
+    ]
+    snap = watch_snapshot(records)
+    assert snap["state"] == "running"
+    assert snap["rounds"] == 2
+    assert snap["last_train_loss"] == 1.5
+    assert snap["coverage_pct"] == 75.0
+    assert snap["pager_window"]["hit_rate"] == 0.9
+    assert snap["health"] == {"divergence": 1}
+    frame = format_watch(snap, "p")
+    assert "[RUNNING]" in frame and "coverage 75.0%" in frame
+    assert "pager hit rate 90.0%" in frame
+    # a run_summary record flips the state to completed
+    snap2 = watch_snapshot(records + [
+        {"event": "run_summary", "rounds": 2, "wall_time_sec": 1.0}
+    ])
+    assert snap2["state"] == "completed"
+    assert "[COMPLETED]" in format_watch(snap2)
+
+
+def test_watch_follow_renders_and_exits(tmp_path, capsys):
+    path = tmp_path / "r.metrics.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"round": 1, "train_loss": 1.0}) + "\n")
+        f.write(json.dumps({"event": "run_summary", "rounds": 1}) + "\n")
+    # completed log: one frame, exit 0, no sleep loop
+    assert watch_follow(str(path), interval=0.01) == 0
+    assert "[COMPLETED]" in capsys.readouterr().out
+    # a mid-fit (no run_summary) log bounded by max_refreshes exits 0
+    with open(path, "w") as f:
+        f.write(json.dumps({"round": 1, "train_loss": 1.0}) + "\n")
+    assert watch_follow(str(path), interval=0.01, max_refreshes=1) == 0
+    assert "[RUNNING]" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# driver e2e + parity (the tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(out, engine="sharded", fuse=1, rounds=4, population=True, **over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": rounds, "server.eval_every": 0,
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 32, "client.batch_size": 16,
+        "run.out_dir": str(out), "run.metrics_flush_every": 2,
+        "run.engine": engine, "run.fuse_rounds": fuse,
+        "run.obs.population.enabled": population,
+        **over,
+    })
+    return cfg.validate()
+
+
+def _fit(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    return exp, state
+
+
+def _records(out, name="mnist_fedavg_2"):
+    path = os.path.join(str(out), f"{name}.metrics.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _pop_records(out):
+    """population_health records with volatile fields removed: the
+    logger's timestamp/schema plus every `*_ms` wall-clock key — what
+    remains is the engine-parity material."""
+    recs = [
+        r for r in _records(out) if r.get("event") == "population_health"
+    ]
+    cleaned = []
+    for r in recs:
+        r = dict(r)
+        r.pop("time", None)
+        r.pop("schema", None)
+        cleaned.append(strip_timing_keys(r))
+    return cleaned
+
+
+def test_population_records_land_and_params_unchanged(tmp_path):
+    """The e2e smoke: records per flush window with sane counts,
+    run_summary totals, and the pure-observability pin (population-on
+    params == population-off params bitwise)."""
+    import jax
+
+    _, on = _fit(_cfg(tmp_path / "on"))
+    _, off = _fit(_cfg(tmp_path / "off", population=False))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        on["params"], off["params"],
+    )
+    pops = _pop_records(tmp_path / "on")
+    # 4 rounds / flush_every 2 → 2 windows
+    assert len(pops) == 2
+    assert all(r["window_rounds"] == 2 for r in pops)
+    assert all(r["participants"] == 8 for r in pops)
+    assert sum(r["draws"]["uniform"] for r in pops) == 16
+    assert pops[-1]["coverage"]["num_clients"] == 8
+    assert 1 <= pops[-1]["coverage"]["unique_clients_est"] <= 8
+    run_sum = [
+        r for r in _records(tmp_path / "on")
+        if r.get("event") == "run_summary"
+    ][-1]
+    assert run_sum["population_participations"] == 16
+    assert 0 < run_sum["population_coverage_pct"] <= 100.0
+    assert not any(
+        r.get("event") == "population_health"
+        for r in _records(tmp_path / "off")
+    )
+
+
+def test_population_parity_engines_and_fusion(tmp_path):
+    """The tier-1 acceptance pin (krum × sign_flip, ledger on): the
+    count-based population_health columns are IDENTICAL across
+    sharded↔sequential↔fused — every tracked quantity is a pure
+    function of the host-side cohort schedule, which the engines
+    share. Only `*_ms` wall-clock fields (stripped here) may differ."""
+    over = {
+        "server.aggregator": "krum",
+        "attack.kind": "sign_flip", "attack.fraction": 0.25,
+        "run.obs.client_ledger.enabled": True,
+    }
+    _fit(_cfg(tmp_path / "sh", "sharded", **over))
+    _fit(_cfg(tmp_path / "sq", "sequential", **over))
+    _fit(_cfg(tmp_path / "fu", "sharded", fuse=2, **over))
+    sh, sq, fu = (
+        _pop_records(tmp_path / d) for d in ("sh", "sq", "fu")
+    )
+    assert len(sh) == 2
+    assert sh == sq, "sharded vs sequential population records diverged"
+    assert sh == fu, "unfused vs fused population records diverged"
+
+
+def test_population_stream_store_pager_sections(tmp_path):
+    """The million-client composition on a shrunk shape: store-backed
+    stream placement + streaming sampler + paged ledger → the record
+    carries all four planes (sampler sketch, pager, store I/O, slab
+    dedup), the pager window hit/miss counts reconcile with the
+    pager's lifetime totals, and run_summary carries the store/pager
+    totals."""
+    from colearn_federated_learning_tpu.data.store import (
+        build_synthetic_store,
+    )
+
+    store = build_synthetic_store(
+        str(tmp_path / "store"), num_clients=64, examples_per_client=2,
+        shape=(12, 12, 1), num_classes=10, seed=0, test_examples=32,
+    )
+    cfg = _cfg(
+        tmp_path / "run", rounds=6,
+        **{
+            "data.num_clients": 64, "data.store.dir": store,
+            "data.placement": "stream", "server.sampling": "streaming",
+            "client.batch_size": 2, "data.max_examples_per_client": 2,
+            "run.obs.client_ledger.enabled": True,
+            "run.obs.client_ledger.log_every": 2,
+            "run.obs.client_ledger.hot_capacity": 8,
+        },
+    )
+    exp, _ = _fit(cfg)
+    pops = _pop_records(tmp_path / "run")
+    assert pops, "no population records on the streaming path"
+    last = pops[-1]
+    assert "sketch" in last and 0.0 <= last["sketch"]["occupancy"] <= 1.0
+    draws = {}
+    for r in pops:
+        for k, v in r.get("draws", {}).items():
+            draws[k] = draws.get(k, 0) + v
+    # streaming draws are split by pool, and every accepted draw counted
+    assert sum(draws.values()) == 6 * 4
+    assert set(draws) <= {"explore", "scored", "unseen", "backstop"}
+    pager_sum = {
+        k: sum(r["pager"][k] for r in pops if "pager" in r)
+        for k in ("hits", "misses", "page_ins", "evictions", "page_syncs")
+    }
+    assert pager_sum["hits"] == exp._pager.hits
+    assert pager_sum["misses"] == exp._pager.misses == exp._pager.page_ins
+    store_rows = sum(
+        r["store"]["rows_gathered"] for r in pops if "store" in r
+    )
+    assert store_rows > 0
+    assert all(
+        r["store"]["slab_dedup_ratio"] <= 1.0
+        for r in pops if "slab_dedup_ratio" in r.get("store", {})
+    )
+    run_sum = [
+        r for r in _records(tmp_path / "run")
+        if r.get("event") == "run_summary"
+    ][-1]
+    assert "pager_hit_rate" in run_sum
+    assert run_sum["store_gather_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# CLIs: watch / population / summarize surfacing / store info
+# ---------------------------------------------------------------------------
+
+
+def _fit_run(tmp_path, **over):
+    out = tmp_path / "runs"
+    _fit(_cfg(out, **over))
+    return out
+
+
+def test_watch_cli_json_and_once(tmp_path, capsys):
+    out = _fit_run(tmp_path)
+    rc = cli.main(["watch", "mnist_fedavg_2", "--out-dir", str(out),
+                   "--json"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["state"] == "completed"
+    assert snap["rounds"] == 4
+    assert snap["coverage_pct"] > 0
+    rc = cli.main(["watch", "mnist_fedavg_2", "--out-dir", str(out),
+                   "--once"])
+    assert rc == 0
+    assert "[COMPLETED]" in capsys.readouterr().out
+
+
+def test_watch_cli_mid_fit_truncated_log(tmp_path, capsys):
+    """The in-progress contract: a live log whose tail is a torn,
+    mid-record JSONL line renders (skipping the torn line), and the
+    snapshot reads as RUNNING — no run_summary yet."""
+    run = tmp_path / "live"
+    run.mkdir()
+    with open(run / "fit.metrics.jsonl", "w") as f:
+        f.write(json.dumps({"round": 1, "train_loss": 2.0}) + "\n")
+        f.write(json.dumps({"round": 2, "train_loss": 1.0,
+                            "rounds_per_sec": 2.5}) + "\n")
+        f.write('{"round": 3, "train_lo')  # writer mid-line
+    rc = cli.main(["watch", str(run), "--json"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["state"] == "running"
+    assert snap["rounds"] == 2  # the torn record is not counted
+    rc = cli.main(["watch", str(run), "--once"])
+    assert rc == 0
+    assert "[RUNNING]" in capsys.readouterr().out
+
+
+def test_watch_cli_exit_2_contract(tmp_path, capsys):
+    # missing run dir / unknown run name
+    assert cli.main(["watch", str(tmp_path / "nope")]) == 2
+    assert "error" in capsys.readouterr().err
+    # empty log: same contract as summarize
+    run = tmp_path / "empty"
+    run.mkdir()
+    (run / "x.metrics.jsonl").touch()
+    assert cli.main(["watch", str(run)]) == 2
+    assert "no metrics records" in capsys.readouterr().err
+    # dir with no metrics file at all
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    assert cli.main(["watch", str(bare), "--json"]) == 2
+
+
+def test_population_cli_report_and_exit_2(tmp_path, capsys):
+    out = _fit_run(tmp_path)
+    rc = cli.main(["population", "mnist_fedavg_2", "--out-dir", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "coverage:" in text and "fairness" in text
+    rc = cli.main(["population", "mnist_fedavg_2", "--out-dir", str(out),
+                   "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["rounds"] == 4 and rep["participants"] == 16
+    # a run without population records exits 2 with a clean error
+    off = tmp_path / "off"
+    _fit(_cfg(off, population=False))
+    rc = cli.main(["population", "mnist_fedavg_2", "--out-dir", str(off)])
+    assert rc == 2
+    assert "population_health" in capsys.readouterr().err
+
+
+def test_population_report_format_roundtrip():
+    with pytest.raises(ValueError):
+        population_report([{"round": 1}])
+    rep = population_report([{
+        "event": "population_health", "round": 2, "window_rounds": 2,
+        "participants": 8,
+        "coverage": {"unique_clients_est": 4, "coverage_pct": 50.0,
+                     "num_clients": 8},
+        "fairness": {"total_participations": 8, "tracked": 4,
+                     "gini": 0.1, "max_share": 0.25,
+                     "top_clients": [[1, 2]]},
+        "staleness": {"first_seen": 4, "known": 2, "mean": 1.0,
+                      "p50": 1.0, "max": 1},
+        "draws": {"uniform": 8},
+        "pager": {"hits": 3, "misses": 1, "page_ins": 1, "evictions": 0,
+                  "page_syncs": 1, "sync_stall_ms": 0.5},
+        "store": {"gather_calls": 2, "rows_gathered": 10,
+                  "bytes_gathered": 100, "gather_ms": 0.1,
+                  "shard_touches": [2, 1], "slab_rows_indexed": 20,
+                  "slab_rows_unique": 10},
+    }])
+    assert rep["pager"]["hit_rate"] == 0.75
+    assert rep["store"]["slab_dedup_ratio"] == 0.5
+    text = format_population_report(rep, "p")
+    assert "hit rate 75.0%" in text
+    assert "s0:2 s1:1" in text
+
+
+def test_summarize_surfaces_paging_and_population(tmp_path, capsys):
+    """The satellite: `colearn summarize` renders the PR 9 paging
+    totals and the new population totals out of run_summary."""
+    run = tmp_path / "r"
+    run.mkdir()
+    with open(run / "x.metrics.jsonl", "w") as f:
+        f.write(json.dumps({"round": 1, "train_loss": 1.0}) + "\n")
+        f.write(json.dumps({
+            "event": "run_summary", "rounds": 1, "wall_time_sec": 1.0,
+            "ledger_evictions": 7, "ledger_page_syncs": 3,
+            "population_unique_clients": 42,
+            "population_coverage_pct": 21.0,
+            "population_participations": 99,
+            "pager_hit_rate": 0.875, "store_gather_bytes": 2048,
+        }) + "\n")
+    rc = cli.main(["summarize", str(run)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "ledger paging: 7 evictions, 3 page syncs" in text
+    assert "42 unique clients (21.0% coverage)" in text
+    assert "pager hit rate 87.5%" in text
+    assert "store gathered 2.0 KiB" in text
+    rc = cli.main(["summarize", str(run), "--json"])
+    assert rc == 0
+    agg = json.loads(capsys.readouterr().out)
+    assert agg["ledger_paging"] == {
+        "ledger_evictions": 7, "ledger_page_syncs": 3
+    }
+    assert agg["population"]["population_unique_clients"] == 42
+
+
+def test_store_info_per_shard_and_json(tmp_path, capsys):
+    """The satellite: `store info` reports per-shard byte sizes and
+    client counts (clients never span shards, so the per-shard client
+    counts partition the federation) and gains --json."""
+    from colearn_federated_learning_tpu.data import build_federated_data
+    from colearn_federated_learning_tpu.data.store import write_store
+    from colearn_federated_learning_tpu.config import DataConfig
+
+    fed = build_federated_data(
+        DataConfig(name="mnist", num_clients=24, partition="iid",
+                   synthetic_train_size=240, synthetic_test_size=32),
+        seed=0,
+    )
+    store = write_store(str(tmp_path / "st"), fed, shard_mb=0.002)
+    rc = cli.main(["store", "info", store, "--json"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    shards = info["shards"]
+    assert len(shards) == info["num_shards"] > 1
+    assert sum(s["clients"] for s in shards) == 24
+    assert sum(s["examples"] for s in shards) == info["num_examples"]
+    assert all(s["x_mb"] >= 0 for s in shards)
+    # default output is now the human table
+    rc = cli.main(["store", "info", store])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "shard" in text and "clients" in text
+    assert f"clients: 24" in text
+
+
+def test_population_config_validation():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.run.obs.population.hll_bits = 99
+    with pytest.raises(ValueError, match="hll_bits"):
+        cfg.validate()
+    cfg.run.obs.population.hll_bits = 12
+    cfg.run.obs.population.top_k = 0
+    with pytest.raises(ValueError, match="top_k"):
+        cfg.validate()
+    cfg.run.obs.population.top_k = 64
+    cfg.run.obs.population.recency_capacity = 0
+    with pytest.raises(ValueError, match="recency_capacity"):
+        cfg.validate()

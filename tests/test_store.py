@@ -226,8 +226,14 @@ def test_store_cli_build_info_and_fit(tmp_path, capsys):
     assert rc == 0
     desc = json.loads(capsys.readouterr().out)
     assert desc["num_clients"] == 4 and desc["num_examples"] == 128
+    # info's default is the human table now; --json keeps the object
+    assert cli.main(["store", "info", out, "--json"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["num_clients"] == 4
+    # per-shard breakdown (PR 10): whole clients partition over shards
+    assert sum(s["clients"] for s in info["shards"]) == 4
     assert cli.main(["store", "info", out]) == 0
-    assert json.loads(capsys.readouterr().out)["num_clients"] == 4
+    assert "clients: 4" in capsys.readouterr().out
     # a store-backed fit straight through the CLI
     rc = cli.main([
         "fit", "--config", "mnist_fedavg_2", "--out-dir", "",
