@@ -409,6 +409,12 @@ def bench_config(name: str):
         # every round — record the switch so throughput numbers stay
         # comparable across BENCH entries
         "population": bool(cfg.run.obs.population.enabled),
+        # LoRA adapter plane (model.lora): adapter-only uploads change
+        # both the wire story and the per-round compute — every result
+        # records the switch and the analytic full÷adapter upload-byte
+        # ratio (exactly 1.0 with lora off)
+        "lora": bool(cfg.model.lora.enabled),
+        "wire_reduction_vs_full": round(exp.wire_reduction_vs_full(), 2),
     }
     for k, v in overrides.items():
         extra[f"override:{k}"] = v
@@ -495,6 +501,19 @@ def bench_config(name: str):
 _STORE_SCALE = {
     "store_scale_1k": 1_000,
     "store_scale_1m": 1_000_000,
+}
+
+# LoRA × store-scale entries (ROADMAP item 3 acceptance): BERT-tiny
+# transformer federation over the mmap client store at 10³ and 10⁶
+# clients, adapter-only uploads (rank-2 attention LoRA ⇒ ~133× fewer
+# upload bytes than the full-delta twin at this geometry — recorded as
+# extra.wire_reduction_vs_full), streaming sampler + paged ledger +
+# population tracking. The acceptance bar mirrors PR 9's: the
+# 10⁶-client entry's peak_host_rss_mb must stay within 1.5× the
+# 10³-client twin's in the same BENCH_r*.json.
+_LORA_SCALE = {
+    "bert_lora_1k": 1_000,
+    "bert_lora_1m": 1_000_000,
 }
 
 
@@ -594,6 +613,118 @@ def bench_store_scale(name: str):
                     "population_unique_clients"
                 ),
                 "pager_hit_rate": pop_totals.get("pager_hit_rate"),
+                "lora": False,
+                "wire_reduction_vs_full": round(
+                    exp.wire_reduction_vs_full(), 2
+                ),
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_lora_scale(name: str):
+    """The transformer twin of :func:`bench_store_scale`: a BERT-tiny
+    LoRA federation over an on-the-fly synthetic LM store — adapter
+    uploads, stream placement, streaming sampler fed by the paged
+    ledger, population tracking. Records rounds/sec plus the three
+    numbers the ROADMAP item-3 acceptance reads: peak_host_rss_mb
+    (≤1.5× the 1k twin at 10⁶ clients), coverage_pct, and
+    wire_reduction_vs_full."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.data.store import (
+        build_synthetic_lm_store,
+    )
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    n = _LORA_SCALE[name]
+    warmup, timed = 2, 6
+    seq_len, vocab = 32, 64
+    tmp = tempfile.mkdtemp(prefix=f"bench_{name}_")
+    try:
+        t_build0 = time.perf_counter()
+        build_synthetic_lm_store(
+            tmp, num_clients=n, examples_per_client=2, seq_len=seq_len,
+            vocab_size=vocab, seed=0, test_examples=64,
+        )
+        build_sec = time.perf_counter() - t_build0
+        cfg = get_named_config("bert_lora_federated")
+        cfg.apply_overrides({
+            "data.num_clients": n, "data.store.dir": tmp,
+            "data.placement": "stream",
+            "model.kwargs.seq_len": seq_len,
+            "model.kwargs.vocab_size": vocab,
+            "server.cohort_size": 16, "client.batch_size": 2,
+            "server.num_rounds": warmup + timed, "server.eval_every": 0,
+            "server.checkpoint_every": 0, "run.out_dir": "",
+            "run.client_vmap_width": 1,
+            "run.obs.population.enabled": True,
+            "run.obs.client_ledger.enabled": True,
+            "run.obs.client_ledger.log_every": 2,
+            "run.obs.client_ledger.hot_capacity": 64,
+        })
+        cfg.validate()
+        exp = Experiment(cfg, echo=False)
+        state = exp._place_state(exp.init_state())
+        for r in range(warmup):
+            state = exp.run_round(state, r)
+            exp._ledger_ref = state.get("ledger")
+            state.pop("_metrics")
+        t0 = time.perf_counter()
+        pending = []
+        for r in range(warmup, warmup + timed):
+            state = exp.run_round(state, r)
+            exp._ledger_ref = state.get("ledger")
+            pending.append(state.pop("_metrics"))
+        fetched = jax.device_get(pending)
+        dt = time.perf_counter() - t0
+        rss = _peak_host_rss_mb()
+        pop_totals = exp._population.summary_totals(
+            exp._pager, (exp.fed.train_x, exp.fed.train_y)
+        )
+        return {
+            "metric": (
+                f"FL rounds/sec ({n}-client mmap LM store, bert_tiny "
+                f"rank-{cfg.model.lora.rank} LoRA, cohort "
+                f"{cfg.server.cohort_size}, streaming sampler)"
+            ),
+            "value": round(timed / dt, 4),
+            "unit": "rounds/sec",
+            "vs_baseline": 1.0,
+            "extra": {
+                "num_clients": n,
+                "peak_host_rss_mb": rss,
+                "store_backed": True,
+                "store_build_sec": round(build_sec, 2),
+                "placement": "stream",
+                "sampler": "streaming",
+                "platform": jax.devices()[0].platform,
+                "timed_rounds": timed,
+                "final_train_loss": round(
+                    float(fetched[-1].train_loss), 4
+                ),
+                # the PR 9 budget the acceptance reads: the 1m entry's
+                # peak RSS vs the 1k twin's in the same BENCH_r*.json
+                "rss_budget_vs_1k": 1.5,
+                "population": True,
+                "coverage_pct": pop_totals.get("population_coverage_pct"),
+                "unique_clients_est": pop_totals.get(
+                    "population_unique_clients"
+                ),
+                "pager_hit_rate": pop_totals.get("pager_hit_rate"),
+                # the adapter-plane headline: full-delta ÷ adapter
+                # upload bytes at this geometry (analytic, config-pure)
+                "lora": True,
+                "lora_rank": cfg.model.lora.rank,
+                "lora_target": cfg.model.lora.target,
+                "wire_reduction_vs_full": round(
+                    exp.wire_reduction_vs_full(), 2
+                ),
             },
         }
     finally:
@@ -603,12 +734,15 @@ def bench_store_scale(name: str):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default="cifar10_fedavg_100",
-                    choices=sorted(_SHAPES) + sorted(_STORE_SCALE))
+                    choices=(sorted(_SHAPES) + sorted(_STORE_SCALE)
+                             + sorted(_LORA_SCALE)))
     ap.add_argument("--matrix", action="store_true",
                     help="bench every config; one JSON line each")
     args = ap.parse_args(argv)
     if not args.matrix:
-        if args.config in _STORE_SCALE:
+        if args.config in _LORA_SCALE:
+            print(json.dumps(bench_lora_scale(args.config)), flush=True)
+        elif args.config in _STORE_SCALE:
             print(json.dumps(bench_store_scale(args.config)), flush=True)
         else:
             print(json.dumps(bench_config(args.config)), flush=True)
@@ -619,7 +753,7 @@ def main(argv=None):
     import subprocess
     import sys
 
-    for name in sorted(_SHAPES) + sorted(_STORE_SCALE):
+    for name in sorted(_SHAPES) + sorted(_STORE_SCALE) + sorted(_LORA_SCALE):
         proc = subprocess.run(
             [sys.executable, __file__, "--config", name],
             capture_output=True, text=True,

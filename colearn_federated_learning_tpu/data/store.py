@@ -131,6 +131,17 @@ class ShardedRecordArray:
             n = int(self._bounds[s + 1] - self._bounds[s])
             m = np.memmap(self._paths[s], dtype=self.dtype, mode="r",
                           shape=(n,) + self._rec_shape)
+            try:
+                # cohort gathers are random-access by construction;
+                # without this the kernel's sequential readahead drags
+                # ~128 KB of neighbouring records into RSS per touched
+                # record, which at 10⁶ clients dominates the host-
+                # memory budget the store exists to hold flat
+                import mmap as _mmap
+
+                m._mmap.madvise(_mmap.MADV_RANDOM)
+            except (AttributeError, OSError, ValueError):
+                pass  # platform without madvise: correctness unchanged
             self._maps[s] = m
         return m
 
@@ -247,6 +258,23 @@ class _ShardWriter:
     def close_shard(self) -> None:
         for f in (self._fx, self._fy):
             if f is not None:
+                # land the shard on disk and DROP it from the page
+                # cache: a just-built store otherwise leaves the whole
+                # corpus as hot cache pages, and the reader's first
+                # gathers then fault-around-map those pages wholesale —
+                # at 10⁶ clients that inflates the builder process's
+                # peak RSS by O(corpus), the exact number the mmap
+                # store exists to keep O(cohort). Cold first reads are
+                # the honest trade (MADV_RANDOM keeps them one page per
+                # touched record).
+                try:
+                    f.flush()
+                    os.fsync(f.fileno())
+                    os.posix_fadvise(
+                        f.fileno(), 0, 0, os.POSIX_FADV_DONTNEED
+                    )
+                except (AttributeError, OSError):
+                    pass  # platform without fadvise: behavior unchanged
                 f.close()
         self._fx = self._fy = None
 
@@ -378,6 +406,64 @@ def build_synthetic_store(
         num_classes=num_classes, task="classify", source="store(synthetic)",
         test_examples=test_examples,
         extra={"seed": int(seed), "template_weight": float(template_weight)},
+    )
+    return out_dir
+
+
+def build_synthetic_lm_store(
+    out_dir: str,
+    num_clients: int,
+    examples_per_client: int = 2,
+    seq_len: int = 16,
+    vocab_size: int = 32,
+    seed: int = 0,
+    test_examples: int = 64,
+    shard_mb: float = 64,
+) -> str:
+    """The LM twin of :func:`build_synthetic_store`: stream a
+    deterministic synthetic next-token federation (the sparse-Markov
+    sequence family from data/core.py — learnable well above chance)
+    straight to shards, a fixed ``_GEN_CHUNK_CLIENTS`` clients at a
+    time. Records are ``x: [seq_len] int32`` tokens with ``y:
+    [seq_len]`` next-token targets; ``task="lm"`` and
+    ``num_classes=vocab_size`` ride the meta so ``data.store.dir``
+    activates the LM task end to end. Deterministic in ``seed`` alone
+    (same contract as the image builder: the chunk size is a fixed
+    constant and ``shard_mb`` cannot change a byte). This is the
+    store the ``bert_lora_1k``/``bert_lora_1m`` bench entries build —
+    million-client transformer federation on adapter uploads."""
+    from colearn_federated_learning_tpu.data.core import _synthetic_text
+
+    if num_clients < 1 or examples_per_client < 1:
+        raise ValueError(
+            f"need num_clients >= 1 and examples_per_client >= 1, got "
+            f"{num_clients} / {examples_per_client}"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng((int(seed), 0x570_1_3))
+    successors = rng.integers(0, vocab_size, size=(vocab_size, 4))
+    writer = _ShardWriter(out_dir, shard_mb)
+    done = 0
+    while done < num_clients:
+        k = min(_GEN_CHUNK_CLIENTS, num_clients - done)
+        x, y = _synthetic_text(
+            rng, k * examples_per_client, seq_len, vocab_size, successors
+        )
+        writer.write_clients(x, y)
+        done += k
+    writer.close_shard()
+    ex, ey = _synthetic_text(
+        rng, test_examples, seq_len, vocab_size, successors
+    )
+    np.savez(os.path.join(out_dir, _TEST), x=ex, y=ey)
+    counts = np.full(num_clients, examples_per_client, np.int64)
+    _write_meta(
+        out_dir, counts=counts, shard_counts=writer.shard_counts,
+        x_shape=(seq_len,), x_dtype=np.int32,
+        y_shape=(seq_len,), y_dtype=np.int32,
+        num_classes=vocab_size, task="lm", source="store(synthetic_lm)",
+        test_examples=test_examples,
+        extra={"seed": int(seed), "vocab_size": int(vocab_size)},
     )
     return out_dir
 

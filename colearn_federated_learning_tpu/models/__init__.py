@@ -20,14 +20,56 @@ from colearn_federated_learning_tpu.utils.registry import Registry
 model_registry = Registry("model")
 
 
+def _allowed_kwargs(factory) -> set:
+    """Named parameters of a zoo factory (its real knob surface — every
+    builder also takes a ``**_`` sink so shared driver kwargs like
+    ``compute_dtype`` flow everywhere, which is exactly why a TYPO'd
+    kwarg used to vanish silently and surface as a shape error deep in
+    Flax init)."""
+    import inspect
+
+    return {
+        p.name
+        for p in inspect.signature(factory).parameters.values()
+        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+    }
+
+
 def build_model(name: str, num_classes: int, **kwargs):
-    """Instantiate a model module from the zoo."""
-    return model_registry.get(name)(num_classes=num_classes, **kwargs)
+    """Instantiate a model module from the zoo.
+
+    Unknown ``name`` and unknown ``kwargs`` both raise a ValueError
+    naming the allowed set — a config typo fails at construction with
+    the fix in the message, not minutes later inside Flax init."""
+    try:
+        factory = model_registry.get(name)
+    except KeyError:
+        raise ValueError(
+            f"unknown model.name {name!r}; known models: "
+            f"{', '.join(model_registry.names())}"
+        ) from None
+    unknown = set(kwargs) - _allowed_kwargs(factory)
+    if unknown:
+        allowed = sorted(
+            _allowed_kwargs(factory) - {"num_classes"}
+        )
+        raise ValueError(
+            f"unknown model.kwargs for {name!r}: "
+            f"{', '.join(sorted(unknown))}; allowed kwargs: "
+            f"{', '.join(allowed)}"
+        )
+    return factory(num_classes=num_classes, **kwargs)
 
 
 def model_input_spec(name: str, **kwargs) -> Tuple[Tuple[int, ...], Any]:
     """(example input shape without batch dim, dtype) for a model family."""
-    spec = _INPUT_SPECS[name]
+    try:
+        spec = _INPUT_SPECS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model.name {name!r}; known models: "
+            f"{', '.join(sorted(_INPUT_SPECS))}"
+        ) from None
     if callable(spec):
         return spec(**kwargs)
     return spec

@@ -1,0 +1,267 @@
+"""LoRA adapter plane (ROADMAP item 3): parameter-efficient transformer
+federation over the million-client store.
+
+Low-rank adaptation (Hu et al. 2021): every targeted dense kernel
+``W [d_in, d_out]`` gains a rank-r adapter pair ``A [d_in, r]``,
+``B [r, d_out]`` and the effective weight becomes ``W + (alpha/r)·A·B``.
+The base model is FROZEN; clients train, upload, and the server
+aggregates ONLY the adapter factors — which is what makes transformer
+federation wire-feasible at population scale (the per-client upload
+drops by ``|W| / |A|+|B| ≈ d/(2r)`` per target, 100–1000× end to end;
+the analytic wire counters log the realized ratio as
+``wire_reduction_vs_full``).
+
+Design: the whole round stack (engines, aggregation, compression,
+attacks, ledger, reputation, checkpointing, wire counters) operates on
+ONE opaque params pytree. :class:`LoRAModel` therefore makes the
+adapters BE that pytree — ``model.init`` returns adapters only,
+``model.apply`` merges them into the frozen base before the underlying
+forward — so every subsystem runs in adapter space *by construction*:
+the ``[K, ·]`` wire stack carries adapter deltas, krum/median order
+statistics rank flattened factors, the forensic ledger's norm/cosine
+stats are adapter-space, and eval/checkpoints see the merged
+``W + (alpha/r)·BA`` model through the same ``apply``. No engine code
+knows LoRA exists; with ``model.lora.enabled=false`` no wrapper is
+constructed anywhere and runs are bitwise the pre-LoRA build
+(test-pinned).
+
+Targets: the dense kernels inside the repeated transformer blocks of
+the two transformer families (``bert_tiny``'s ``TransformerBlock_*``,
+``vit_b16``'s ``ViTBlock_*``). Within a block, ``Dense_0`` (the fused
+qkv projection) and ``Dense_1`` (the attention output projection) are
+the ``"attention"`` target set; ``Dense_2``/``Dense_3`` (the MLP
+in/out projections) are ``"mlp"``; ``"all"`` is both. Embeddings, the
+weight-tied LM head, LayerNorms, patchify conv, and the classifier
+head stay frozen — the Hu et al. recipe. Non-transformer zoo members
+have no injection map and are rejected with a clear error
+(``LORA_SUPPORTED``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# model families with a defined injection map (config.validate() and
+# the wrapper both check against this)
+LORA_SUPPORTED = ("bert_tiny", "vit_b16")
+
+LORA_TARGETS = ("attention", "mlp", "all")
+
+# block-module prefixes whose Dense kernels are adapter targets, and
+# which Dense index within a block belongs to which target set
+_BLOCK_PREFIXES = ("TransformerBlock_", "ViTBlock_")
+_ATTENTION_DENSE = ("Dense_0", "Dense_1")  # qkv proj, attention out
+_MLP_DENSE = ("Dense_2", "Dense_3")  # MLP in, MLP out
+
+Path = Tuple[str, ...]
+
+
+def lora_target_paths(base_params, target: str) -> List[Path]:
+    """Paths (tuples of pytree keys ending in ``"kernel"``) of every
+    dense kernel the configured ``target`` set adapts, in deterministic
+    sorted order. Raises with a clear message when the model has no
+    transformer blocks (no injection map) or the target set is empty."""
+    if target not in LORA_TARGETS:
+        raise ValueError(
+            f"unknown model.lora.target {target!r}; "
+            f"allowed: {', '.join(LORA_TARGETS)}"
+        )
+    wanted = set()
+    if target in ("attention", "all"):
+        wanted.update(_ATTENTION_DENSE)
+    if target in ("mlp", "all"):
+        wanted.update(_MLP_DENSE)
+    paths: List[Path] = []
+    flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    for keypath, leaf in flat:
+        keys = tuple(
+            k.key if hasattr(k, "key") else str(k) for k in keypath
+        )
+        if len(keys) < 3 or keys[-1] != "kernel":
+            continue
+        block, dense = keys[-3], keys[-2]
+        if not block.startswith(_BLOCK_PREFIXES):
+            continue
+        if dense in wanted and getattr(leaf, "ndim", 0) == 2:
+            paths.append(keys)
+    if not paths:
+        raise ValueError(
+            "model.lora found no adapter targets: the model has no "
+            f"transformer-block dense kernels (LoRA supports "
+            f"{', '.join(LORA_SUPPORTED)}; target={target!r})"
+        )
+    return sorted(paths)
+
+
+def _get_path(tree, path: Path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def init_lora_params(base_params, rank: int, target: str, rng) -> Dict:
+    """Build the adapter pytree for ``base_params``: a nested dict
+    mirroring the targeted blocks, each target kernel ``W [d_in,
+    d_out]`` contributing ``{"lora_a": [d_in, r], "lora_b": [r,
+    d_out]}``. ``A ~ N(0, 1/d_in)`` (per-path key folded from ``rng``),
+    ``B = 0`` — so the merged model INITIALLY equals the base exactly
+    (the standard LoRA init; the first update already moves through
+    both factors because ∂/∂B ∝ Aᵀx ≠ 0). Dtypes follow the base
+    kernels (``run.param_dtype``)."""
+    if rank < 1:
+        raise ValueError(f"model.lora.rank must be >= 1, got {rank}")
+    paths = lora_target_paths(base_params, target)
+    adapters: Dict = {}
+    for i, path in enumerate(paths):
+        w = _get_path(base_params, path)
+        d_in, d_out = int(w.shape[0]), int(w.shape[1])
+        if rank >= min(d_in, d_out):
+            raise ValueError(
+                f"model.lora.rank={rank} is not low-rank for kernel "
+                f"{'/'.join(path)} [{d_in}, {d_out}] (needs rank < "
+                f"{min(d_in, d_out)}) — the adapter would be as large "
+                f"as the weight it replaces"
+            )
+        k = jax.random.fold_in(rng, i)
+        a = jax.random.normal(k, (d_in, rank), jnp.float32) * (
+            1.0 / np.sqrt(d_in)
+        )
+        node = adapters
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node["lora_a"] = a.astype(w.dtype)
+        node["lora_b"] = jnp.zeros((rank, d_out), w.dtype)
+    return adapters
+
+
+def merge_lora_params(base_params, adapters, alpha: float, rank: int):
+    """The eval/train-time merge: a copy of ``base_params`` where every
+    adapted kernel becomes ``W + (alpha/rank)·A·B``. The product is
+    computed at the ADAPTER dtype (bf16 under run.local_param_dtype —
+    the local-training cast applies to the factors like any other
+    leaf) and added at the base kernel's dtype, so the frozen base
+    never loses precision. Non-adapted leaves are returned by
+    reference (zero copy)."""
+    scale = float(alpha) / float(rank)
+
+    def walk(base, ad):
+        if not isinstance(ad, dict):
+            return base
+        if "lora_a" in ad:
+            delta = (ad["lora_a"] @ ad["lora_b"]) * jnp.asarray(
+                scale, ad["lora_a"].dtype
+            )
+            return {
+                k: (v + delta.astype(v.dtype) if k == "kernel" else v)
+                for k, v in base.items()
+            }
+        out = dict(base)
+        for k, sub in ad.items():
+            out[k] = walk(base[k], sub)
+        return out
+
+    return walk(base_params, adapters)
+
+
+def count_params(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+class LoRAModel:
+    """Model-like facade whose params pytree IS the adapter set.
+
+    Presents the zoo contract the trainer/driver/engines consume —
+    ``init(rng, x, train=...) -> {"params": adapters}``,
+    ``apply({"params": adapters}, x, ...)``, a ``compute_dtype``
+    attribute — while the frozen base params live as a captured
+    constant inside ``apply`` (XLA embeds them once per compiled
+    program; they are never shipped, aggregated, donated, or
+    checkpointed).
+
+    Binding contract: the base params are a pure function of the rng
+    passed to ``init`` (exactly ``base.init``'s output), so they are
+    NOT checkpointed — a resume/restore re-derives them from
+    ``run.seed`` via the driver's ``init_state`` template and gets the
+    identical base. The first CONCRETE ``init`` call binds them;
+    abstract calls (``jax.eval_shape`` — the wire-counter/HBM
+    pre-flight path) trace through without binding. Calling ``apply``
+    before any concrete ``init`` raises rather than training against
+    an undefined base. Re-``init`` with a different rng rebinds —
+    build a fresh Experiment rather than reusing compiled round
+    programs across bindings.
+    """
+
+    def __init__(self, base, rank: int, alpha: float, target: str):
+        if rank < 1:
+            raise ValueError(f"model.lora.rank must be >= 1, got {rank}")
+        if alpha <= 0.0:
+            raise ValueError(
+                f"model.lora.alpha must be > 0, got {alpha}"
+            )
+        if target not in LORA_TARGETS:
+            raise ValueError(
+                f"unknown model.lora.target {target!r}; "
+                f"allowed: {', '.join(LORA_TARGETS)}"
+            )
+        self.base = base
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.target = target
+        # the trainer reads the model's compute dtype at factory time
+        self.compute_dtype = getattr(base, "compute_dtype", jnp.float32)
+        self._base_params = None
+
+    def init(self, rng, x, train: bool = False):
+        variables = self.base.init(rng, x, train=train)
+        base_params = variables["params"]
+        adapters = init_lora_params(
+            base_params, self.rank, self.target,
+            jax.random.fold_in(rng, 0x10_8A),
+        )
+        if not isinstance(x, jax.core.Tracer):
+            # concrete init: bind the frozen base (deterministic in the
+            # rng — the driver's init_state re-derives it on resume)
+            self._base_params = base_params
+        return {"params": adapters}
+
+    def apply(self, variables, *args, **kwargs):
+        if self._base_params is None:
+            raise RuntimeError(
+                "LoRAModel.apply before any concrete init: the frozen "
+                "base params are bound by the first non-abstract "
+                "init(rng, x) call (Experiment.init_state does this)"
+            )
+        merged = merge_lora_params(
+            self._base_params, variables["params"], self.alpha, self.rank
+        )
+        return self.base.apply({"params": merged}, *args, **kwargs)
+
+    def merged_params(self, adapters):
+        """The deployable full-model params: ``W + (alpha/r)·A·B`` over
+        the bound base — what ``colearn export`` writes for a LoRA run
+        so downstream consumers never need the adapter structure."""
+        if self._base_params is None:
+            raise RuntimeError(
+                "LoRAModel.merged_params before any concrete init"
+            )
+        return merge_lora_params(
+            self._base_params, adapters, self.alpha, self.rank
+        )
+
+
+def build_lora_model(base, model_name: str, rank: int, alpha: float,
+                     target: str) -> LoRAModel:
+    """Wrap a zoo model for adapter-space federation, rejecting model
+    families with no injection map (clear error at construction, not a
+    silent no-adapter run)."""
+    if model_name not in LORA_SUPPORTED:
+        raise ValueError(
+            f"model.lora is not supported for model {model_name!r}: no "
+            f"transformer-block injection map; supported: "
+            f"{', '.join(LORA_SUPPORTED)}"
+        )
+    return LoRAModel(base, rank=rank, alpha=alpha, target=target)

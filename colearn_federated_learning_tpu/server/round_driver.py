@@ -120,6 +120,26 @@ class Experiment:
             param_dtype=_DTYPES[cfg.run.param_dtype],
             **cfg.model.kwargs,
         )
+        # LoRA adapter plane (model.lora, models/lora.py): wrap the
+        # transformer so the params pytree every downstream subsystem
+        # sees IS the adapter set — the base stays frozen inside the
+        # wrapper's apply, the [K,·] wire stack carries adapter deltas,
+        # and aggregation/compression/attacks/ledger/reputation all run
+        # in adapter space with zero engine involvement. lora-off
+        # constructs no wrapper at all (the bitwise-identity contract).
+        self._lora = cfg.model.lora.enabled
+        self._full_param_stats_cache = None
+        self._wire_reduction_cache = None
+        if self._lora:
+            from colearn_federated_learning_tpu.models.lora import (
+                build_lora_model,
+            )
+
+            self.model = build_lora_model(
+                self.model, cfg.model.name,
+                rank=cfg.model.lora.rank, alpha=cfg.model.lora.alpha,
+                target=cfg.model.lora.target,
+            )
         self.fed = build_federated_data(cfg.data, seed=cfg.run.seed, **cfg.model.kwargs)
         self.task = self.fed.task
         self.shape = compute_round_shape(self.fed, cfg.client, cfg.data)
@@ -846,6 +866,53 @@ class Experiment:
 
     def _param_bytes(self) -> int:
         return self._param_stats()[1]
+
+    def _full_param_stats(self) -> tuple:
+        """(n_coords, bytes) of the FULL model — the trained tree's twin
+        with LoRA off. Equals :meth:`_param_stats` for non-LoRA runs;
+        under the adapter plane it is the frozen base model's size, the
+        denominator of ``wire_reduction_vs_full``."""
+        if not self._lora:
+            return self._param_stats()
+        if self._full_param_stats_cache is None:
+            from colearn_federated_learning_tpu.client.trainer import (
+                normalize_input,
+            )
+
+            dummy = jax.ShapeDtypeStruct(
+                (1,) + self.fed.train_x.shape[1:], self.fed.train_x.dtype
+            )
+            shapes = jax.eval_shape(
+                lambda d: self.model.base.init(
+                    jax.random.PRNGKey(0), normalize_input(d), train=False
+                )["params"],
+                dummy,
+            )
+            leaves = jax.tree.leaves(shapes)
+            self._full_param_stats_cache = (
+                sum(int(np.prod(l.shape)) for l in leaves),
+                sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                    for l in leaves),
+            )
+        return self._full_param_stats_cache
+
+    def wire_reduction_vs_full(self) -> float:
+        """Analytic per-client upload-byte ratio full-delta ÷ trained
+        delta on THIS config's wire format (compression applies to both
+        twins, so it cancels) — the logged LoRA communication win,
+        exactly 1.0 for non-LoRA runs. Pure function of the config, so
+        every engine logs the identical number."""
+        if self._wire_reduction_cache is None:
+            coords, p_bytes = self._param_stats()
+            f_coords, f_bytes = self._full_param_stats()
+            up = round_comm_bytes(
+                self.cfg.server, 1, 1, coords, p_bytes
+            )["upload_bytes"]
+            full = round_comm_bytes(
+                self.cfg.server, 1, 1, f_coords, f_bytes
+            )["upload_bytes"]
+            self._wire_reduction_cache = full / max(up, 1)
+        return self._wire_reduction_cache
 
     # ------------------------------------------------------------------
     # analytic phase-cost model (obs/roofline.py)
@@ -1684,17 +1751,38 @@ class Experiment:
         realized participant count (dropouts excluded) uploads, the
         real — non-poisson-pad — cohort downloads."""
         coords, p_bytes = self._param_stats()
+        _, f_bytes = self._full_param_stats()
         if self.gossip:
-            return gossip_round_bytes(
+            stats = gossip_round_bytes(
                 self.fed.num_clients, self.cfg.server.gossip_mixing_steps,
                 self.cfg.server.gossip_topology, p_bytes,
             )
-        return round_comm_bytes(
-            self.cfg.server,
-            n_participants=int((n_host > 0).sum()),
-            n_downloads=int((np.asarray(cohort) < self.fed.num_clients).sum()),
-            n_coords=coords, param_bytes=p_bytes,
+            full_up = gossip_round_bytes(
+                self.fed.num_clients, self.cfg.server.gossip_mixing_steps,
+                self.cfg.server.gossip_topology, f_bytes,
+            )["upload_bytes"]
+        else:
+            n_up = int((n_host > 0).sum())
+            n_down = int(
+                (np.asarray(cohort) < self.fed.num_clients).sum()
+            )
+            stats = round_comm_bytes(
+                self.cfg.server, n_participants=n_up, n_downloads=n_down,
+                n_coords=coords, param_bytes=p_bytes,
+            )
+            f_coords, _ = self._full_param_stats()
+            full_up = round_comm_bytes(
+                self.cfg.server, n_participants=n_up, n_downloads=n_down,
+                n_coords=f_coords, param_bytes=f_bytes,
+            )["upload_bytes"]
+        # LoRA wire accounting (ROADMAP item 3's headline number): what
+        # the FULL-delta twin would have uploaded this round, and the
+        # per-client reduction ratio — 1.0 exactly for non-LoRA runs
+        stats["upload_bytes_full"] = full_up
+        stats["wire_reduction_vs_full"] = round(
+            self.wire_reduction_vs_full(), 2
         )
+        return stats
 
     def _stream_slab(self, idx: np.ndarray):
         """Gather this round's unique example rows into a fixed-shape slab
@@ -2504,7 +2592,8 @@ class Experiment:
         self._rounds_done = 0
         self._run_totals = {
             k: 0 for k in ("upload_bytes", "upload_bytes_raw",
-                           "download_bytes", "download_bytes_raw")
+                           "download_bytes", "download_bytes_raw",
+                           "upload_bytes_full")
         }
         self._total_compiles = 0
         self._total_compile_ms = 0.0
@@ -2593,6 +2682,11 @@ class Experiment:
                     # rebuild
                     **{k: int(v) for k, v in self._db_stats.items()},
                     **{k: int(v) for k, v in self._run_totals.items()},
+                    # adapter-plane wire accounting: the full-delta ÷
+                    # adapter-delta upload ratio (1.0 when lora is off)
+                    "wire_reduction_vs_full": round(
+                        self.wire_reduction_vs_full(), 2
+                    ),
                     # ledger paging accounting: evictions are the cold
                     # spills, page_syncs the blocking hot-set fetches
                     # they forced (0 when the working set fit)
@@ -3347,9 +3441,15 @@ class Experiment:
         store = CheckpointStore(os.path.join(self._run_dir(), "ckpt"))
         state, step = store.restore(step=step, template=self.init_state())
         store.close()
-        out_path = export_params(state["params"], path)
+        params = state["params"]
+        if self._lora:
+            # the deployment artifact is the MERGED model (W +
+            # (alpha/r)·A·B over the seed-derived frozen base) — a
+            # consumer of the export never needs the adapter structure
+            params = self.model.merged_params(params)
+        out_path = export_params(params, path)
         n_params = sum(
-            int(np.prod(p.shape)) for p in jax.tree.leaves(state["params"])
+            int(np.prod(p.shape)) for p in jax.tree.leaves(params)
         )
         return {"event": "exported", "path": out_path, "round": int(state["round"]),
                 "num_params": n_params}

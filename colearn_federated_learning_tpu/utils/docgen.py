@@ -14,6 +14,24 @@ from colearn_federated_learning_tpu import config as config_mod
 
 _SECTIONS = [
     ("model", config_mod.ModelConfig, "Model selection (zoo name + per-family kwargs)."),
+    ("model.lora", config_mod.LoRAConfig,
+     "LoRA adapter plane (models/lora.py): freeze the transformer base "
+     "and train/ship/aggregate ONLY rank-r adapter pairs — every "
+     "targeted dense kernel W gains A [d_in, r] / B [r, d_out] and the "
+     "effective weight is W + (alpha/r)*A*B (B starts at zero, so the "
+     "merged model initially equals the base). The params pytree the "
+     "whole round stack operates on IS the adapter set, so engines, "
+     "aggregation (weighted_mean AND krum/median over flattened "
+     "factors), compression, upload attacks, the forensic ledger, "
+     "reputation, and the wire counters all run in adapter space by "
+     "construction; eval and `colearn export` use the merged model. "
+     "Cuts per-client upload bytes ~d/(2r) per target (the shipped "
+     "bert_lora_federated geometry logs wire_reduction_vs_full = "
+     "136x); supported families: bert_tiny, vit_b16. The frozen base "
+     "is a pure function of run.seed — re-derived on resume, never "
+     "checkpointed or shipped. lora off builds the exact pre-LoRA "
+     "program (bitwise, test-pinned). See docs/DESIGN.md \"LoRA "
+     "adapter plane\"."),
     ("data", config_mod.DataConfig, "Dataset, federation partition, placement."),
     ("data.store", config_mod.StoreConfig,
      "On-disk memory-mapped client store (data/store.py) — the "

@@ -45,6 +45,14 @@ _SHRINK = {
         "model.kwargs.width": 16,
         "server.krum_byzantine": 0,
     },
+    # adapter plane: keeps the LoRA wrapper + streaming sampler; the
+    # blanket cohort shrink applies (uniform rejection draw at 16
+    # clients), vmap width pinned to 1 at the tiny scale
+    "bert_lora_federated": {
+        "data.num_clients": 16,
+        "model.kwargs.seq_len": 16,
+        "run.client_vmap_width": 1,
+    },
     "imagenet_silo_dp": {
         "data.num_clients": 8,
         "server.cohort_size": 8,

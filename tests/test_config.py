@@ -11,7 +11,8 @@ from colearn_federated_learning_tpu.config import (
 def test_named_configs_exist():
     # BASELINE.json:7-11 — the five capability configs, plus the
     # 1000-client north-star scale config (BASELINE.json:5) and the
-    # beyond-reference decentralized + adversarial showcases
+    # beyond-reference decentralized / adversarial / adapter-plane
+    # showcases
     assert list_named_configs() == sorted([
         "mnist_fedavg_2",
         "cifar10_fedavg_100",
@@ -21,6 +22,7 @@ def test_named_configs_exist():
         "imagenet_silo_dp",
         "cifar10_gossip_16",
         "cifar10_krum_byzantine",
+        "bert_lora_federated",
     ])
     for name in list_named_configs():
         cfg = get_named_config(name)

@@ -36,6 +36,38 @@ def test_forward_shapes(name, kwargs, in_shape, in_dtype, out_shape):
         assert jnp.issubdtype(leaf.dtype, jnp.inexact)
 
 
+def test_unknown_model_name_raises_clear_valueerror():
+    """Registry hardening: a model.name typo must fail at construction
+    naming the known set, not as an opaque KeyError."""
+    with pytest.raises(ValueError, match="known models.*lenet5"):
+        build_model("lenet6", num_classes=10)
+
+
+def test_unknown_model_kwargs_raise_clear_valueerror():
+    """A kwargs typo (every builder has a **_ sink for shared driver
+    kwargs, so it used to vanish silently and surface deep in Flax
+    init) must fail at construction listing the allowed knobs."""
+    with pytest.raises(ValueError, match="seq_length.*allowed.*seq_len"):
+        build_model("bert_tiny", num_classes=0, seq_length=16)
+    with pytest.raises(ValueError, match="withd.*allowed.*width"):
+        build_model("resnet18", num_classes=10, withd=16)
+
+
+def test_known_model_kwargs_still_flow():
+    model = build_model("resnet18", num_classes=10, width=16,
+                        compute_dtype=jnp.bfloat16)
+    assert model.width == 16
+
+
+def test_unknown_input_spec_name_raises():
+    from colearn_federated_learning_tpu.models import model_input_spec
+
+    with pytest.raises(ValueError, match="known models"):
+        model_input_spec("no_such_model")
+    shape, dtype = model_input_spec("bert_tiny", seq_len=16)
+    assert shape == (16,) and dtype == jnp.int32
+
+
 def test_no_batch_stats_collections():
     """FL invariant: no mutable batch statistics (GroupNorm everywhere)."""
     for name, kwargs, shape, dtype in [
