@@ -393,6 +393,10 @@ def bench_config(name: str):
         # interpretable across shape re-pins
         "fuse_rounds": cfg.run.fuse_rounds,
         "local_param_dtype": cfg.run.local_param_dtype,
+        # cohort layout (r12): megabatch collapses the cohort axis into
+        # the GEMM batch — throughput/MFU numbers under the two layouts
+        # are different machines, so every result records which one ran
+        "cohort_layout": cfg.run.cohort_layout,
         # the per-client forensic ledger adds an in-program stats block
         # + scatter to every round — throughput numbers with it on are
         # not comparable to ledger-off pins, so record the switch
@@ -502,6 +506,118 @@ _STORE_SCALE = {
     "store_scale_1k": 1_000,
     "store_scale_1m": 1_000_000,
 }
+
+# Weak-scaling entries (ROADMAP item 1 follow-on / ISSUE 12): the SAME
+# per-chip workload — the headline ResNet-18 family under the megabatch
+# cohort layout, K_local clients per chip — run at however many chips
+# are visible, so the BENCH trajectory finally gets an `n_chips` axis.
+# The realized cohort is per_chip × n_chips (cohort-in-the-hundreds on
+# a multi-chip slice; on 1 chip the entry IS the 1-chip pin the
+# `colearn bench-report` weak-scaling-efficiency line divides by).
+# Ideal weak scaling holds updates/sec/chip flat as chips grow.
+_WEAK_SCALE = {
+    "weak_scale_64": 64,
+    "weak_scale_128": 128,
+    "weak_scale_256": 256,
+}
+
+
+def _weak_scale_cfg(per_chip: int, n_chips: int, warmup: int, timed: int):
+    """The weak-scale workload for one (per-chip cohort, chip count)
+    point — factored out so CI can validate every entry's config
+    without paying for a ResNet run."""
+    from colearn_federated_learning_tpu.config import get_named_config
+
+    cohort = per_chip * n_chips
+    cfg = get_named_config("cifar10_fedavg_100")
+    cfg.apply_overrides({
+        # federation sized 2× the cohort so sampling stays a real draw;
+        # the 50k corpus keeps shards non-degenerate up to 2048 clients
+        "data.num_clients": 2 * cohort,
+        "data.synthetic_train_size": 50_000,
+        "data.synthetic_test_size": 1_000,
+        # bounded per-chip step grid: 2 steps × batch 32 per client —
+        # the megabatch block still sees K_local·32 GEMM rows per chip
+        "data.max_examples_per_client": 64,
+        "client.batch_size": 32,
+        "server.cohort_size": cohort,
+        "server.num_rounds": warmup + timed,
+        "server.eval_every": 0,
+        "server.checkpoint_every": 0,
+        "run.out_dir": "",
+        "run.fuse_rounds": 1,
+        "run.cohort_layout": "megabatch",
+        "server.fused_apply": True,
+    })
+    return cfg.validate()
+
+
+def bench_weak_scale(name: str):
+    import jax
+
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    per_chip = _WEAK_SCALE[name]
+    n_chips = len(jax.devices())
+    cohort = per_chip * n_chips
+    warmup, timed = 2, 4
+    cfg = _weak_scale_cfg(per_chip, n_chips, warmup, timed)
+    exp = Experiment(cfg, echo=False)
+    state = exp._place_state(exp.init_state())
+    flops_per_round = _round_flops(exp, state)
+    for r in range(warmup):
+        state = exp.run_round(state, r)
+        state.pop("_metrics")
+    t0 = time.perf_counter()
+    pending = []
+    for r in range(warmup, warmup + timed):
+        state = exp.run_round(state, r)
+        pending.append(state.pop("_metrics"))
+    fetched = jax.device_get(pending)
+    dt = time.perf_counter() - t0
+    rounds_per_sec = timed / dt
+    ups_chip = timed * cohort / dt / exp.n_chips
+    basis, peak_flops = _mfu_basis(cfg)
+    extra = {
+        "weak_scale_per_chip_cohort": per_chip,
+        "cohort_size": cohort,
+        "n_chips": exp.n_chips,
+        "client_updates_per_sec_per_chip": round(ups_chip, 4),
+        "cohort_layout": cfg.run.cohort_layout,
+        "fused_apply": bool(cfg.server.fused_apply),
+        "num_clients": cfg.data.num_clients,
+        "timed_rounds": timed,
+        "platform": jax.devices()[0].platform,
+        "compute_dtype": cfg.run.compute_dtype,
+        "local_param_dtype": cfg.run.local_param_dtype,
+        "mfu_basis": basis,
+        "peak_host_rss_mb": _peak_host_rss_mb(),
+        "final_train_loss": round(float(fetched[-1].train_loss), 4),
+        "lora": False,
+        "wire_reduction_vs_full": round(exp.wire_reduction_vs_full(), 2),
+    }
+    if flops_per_round:
+        extra["model_tflops_per_round"] = round(flops_per_round / 1e12, 3)
+        extra["mfu_pct"] = round(
+            100.0 * flops_per_round * rounds_per_sec
+            / (peak_flops * exp.n_chips), 2
+        )
+    hbm = _hbm_stats()
+    if hbm:
+        extra.update(hbm)
+    return {
+        "metric": (
+            f"FL rounds/sec (weak scaling: {per_chip} clients/chip x "
+            f"{exp.n_chips} chip(s), resnet18, megabatch cohort {cohort})"
+        ),
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        # a weak-scale entry's regression basis is the efficiency line
+        # in `colearn bench-report`, not a scalar baseline ratio
+        "vs_baseline": 1.0,
+        "extra": extra,
+    }
+
 
 # LoRA × store-scale entries (ROADMAP item 3 acceptance): BERT-tiny
 # transformer federation over the mmap client store at 10³ and 10⁶
@@ -614,6 +730,7 @@ def bench_store_scale(name: str):
                 ),
                 "pager_hit_rate": pop_totals.get("pager_hit_rate"),
                 "lora": False,
+                "cohort_layout": cfg.run.cohort_layout,
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),
@@ -722,6 +839,7 @@ def bench_lora_scale(name: str):
                 "lora": True,
                 "lora_rank": cfg.model.lora.rank,
                 "lora_target": cfg.model.lora.target,
+                "cohort_layout": cfg.run.cohort_layout,
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),
@@ -735,12 +853,14 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default="cifar10_fedavg_100",
                     choices=(sorted(_SHAPES) + sorted(_STORE_SCALE)
-                             + sorted(_LORA_SCALE)))
+                             + sorted(_LORA_SCALE) + sorted(_WEAK_SCALE)))
     ap.add_argument("--matrix", action="store_true",
                     help="bench every config; one JSON line each")
     args = ap.parse_args(argv)
     if not args.matrix:
-        if args.config in _LORA_SCALE:
+        if args.config in _WEAK_SCALE:
+            print(json.dumps(bench_weak_scale(args.config)), flush=True)
+        elif args.config in _LORA_SCALE:
             print(json.dumps(bench_lora_scale(args.config)), flush=True)
         elif args.config in _STORE_SCALE:
             print(json.dumps(bench_store_scale(args.config)), flush=True)
@@ -753,7 +873,8 @@ def main(argv=None):
     import subprocess
     import sys
 
-    for name in sorted(_SHAPES) + sorted(_STORE_SCALE) + sorted(_LORA_SCALE):
+    for name in (sorted(_SHAPES) + sorted(_STORE_SCALE)
+                 + sorted(_LORA_SCALE) + sorted(_WEAK_SCALE)):
         proc = subprocess.run(
             [sys.executable, __file__, "--config", name],
             capture_output=True, text=True,

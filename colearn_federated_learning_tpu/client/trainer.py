@@ -109,8 +109,31 @@ def _select_tree(pred, new, old):
 
 def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str,
                         batch_axis: str | None = None, local_dtype=None,
-                        scan_unroll: int = 1):
+                        scan_unroll: int = 1, megabatch: bool = False):
     """Build the pure local-training function for one client-round.
+
+    ``megabatch`` (``run.cohort_layout="megabatch"``): return the BLOCK
+    trainer instead — signature ``(global_params, train_x, train_y,
+    idx [C, steps, batch], mask [C, steps, batch], keys [C, 2],
+    lr_scale?) → (stacked params [C, ...], LocalMetrics with [C]
+    fields)`` — which trains a lane's whole C-client block as one fused
+    computation. The first local step is the SHARED-WEIGHT phase: every
+    client still holds the round's identical broadcast weights, so the
+    step runs with the params (and the zero optimizer state) replicated
+    — the forward and activation-gradient GEMMs contract the flattened
+    ``[C·batch, ...]`` megabatch against ONE un-batched weight, which
+    is what finally feeds the MXU production-sized matmuls on
+    small-batch FL models. Only the per-client weight-gradient
+    contractions are inherently batched (their outputs differ per
+    client). From step 1 on, per-client params have diverged and the
+    remaining steps scan a lane-local ``vmap`` of the SAME step
+    function (one batched GEMM per layer instead of C sequential
+    launches). Both phases reuse the identical per-client step body and
+    the identical per-client key derivation (``split(rng_c, steps)``),
+    so megabatch ≡ spatial ≡ vmap-width parity holds by construction up
+    to GEMM-shape reassociation (test-pinned). ``grad_corr`` (the
+    stateful algorithms' per-client correction) is not supported in the
+    block signature — config.validate() rejects the pairing.
 
     ``batch_axis``: when the mesh carries a second axis that data-parallels
     each client's minibatch (mesh.py ``BATCH_AXIS``), every shard holds
@@ -164,26 +187,17 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
             lambda p: jax.lax.pcast(p, (batch_axis,), to="varying"), tree
         )
 
-    def local_train(global_params, train_x, train_y, idx, mask, rng,
-                    lr_scale=None, grad_corr=None):
-        """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics).
-
-        ``lr_scale``: optional traced scalar multiplying every optimizer
-        update — the round-indexed client LR decay (client.lr_decay).
-        Scaling the final update is exactly scaling the learning rate for
-        both sgd(+momentum) and adamw (optax applies lr as the last
-        scale).
-
-        ``grad_corr``: optional params-shaped tree added to every step's
-        gradient — SCAFFOLD's variance-reduction term (c − cᵢ), constant
-        over the local phase (Karimireddy et al. 2020, eq. 4). Padded
-        steps stay exact no-ops: the correction rides the same validity
-        gate as the gradient.
-        """
+    def _cast_params(global_params):
         if local_dtype is not None:
-            global_params = jax.tree.map(
+            return jax.tree.map(
                 lambda p: p.astype(local_dtype), global_params
             )
+        return global_params
+
+    def _make_step(global_params, train_x, train_y, lr_scale, grad_corr):
+        """The per-client step body, shared VERBATIM by the per-client
+        scan path and both megabatch phases — the layouts cannot drift
+        numerically because they run the same function."""
 
         def step(carry, inp):
             params, opt_state = carry
@@ -253,6 +267,34 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
                 opt_state = _select_tree(valid, new_opt_state, opt_state)
             return (params, opt_state), loss * step_n
 
+        return step
+
+    def _base_opt_state(global_params):
+        if fused_sgd:
+            # momentum buffer (or nothing) — the whole optimizer state
+            return (
+                trees.tree_zeros_like(global_params) if client_cfg.momentum else ()
+            )
+        return opt.init(global_params)
+
+    def local_train(global_params, train_x, train_y, idx, mask, rng,
+                    lr_scale=None, grad_corr=None):
+        """idx/mask: [steps, batch(/shards)]; returns (params, LocalMetrics).
+
+        ``lr_scale``: optional traced scalar multiplying every optimizer
+        update — the round-indexed client LR decay (client.lr_decay).
+        Scaling the final update is exactly scaling the learning rate for
+        both sgd(+momentum) and adamw (optax applies lr as the last
+        scale).
+
+        ``grad_corr``: optional params-shaped tree added to every step's
+        gradient — SCAFFOLD's variance-reduction term (c − cᵢ), constant
+        over the local phase (Karimireddy et al. 2020, eq. 4). Padded
+        steps stay exact no-ops: the correction rides the same validity
+        gate as the gradient.
+        """
+        global_params = _cast_params(global_params)
+        step = _make_step(global_params, train_x, train_y, lr_scale, grad_corr)
         steps = idx.shape[0]
         keys = jax.random.split(rng, steps)
         # Freshly created optimizer-state leaves (e.g. adam's int32 step
@@ -262,13 +304,7 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
         # sequential engine — same trick as privacy/dp.py's accumulators.
         # Under a batch axis the tie-in must be the psummed count, which is
         # batch-invariant like the params carry itself.
-        if fused_sgd:
-            # momentum buffer (or nothing) — the whole optimizer state
-            base_state = (
-                trees.tree_zeros_like(global_params) if client_cfg.momentum else ()
-            )
-        else:
-            base_state = opt.init(global_params)
+        base_state = _base_opt_state(global_params)
         vary0 = 0.0 * _global_count(mask)
         opt_state0 = jax.tree.map(
             lambda x: x + vary0.astype(x.dtype), base_state
@@ -281,7 +317,65 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
         mean_loss = weighted_losses.sum() / jnp.maximum(n, 1.0)
         return params, LocalMetrics(loss=mean_loss, examples=n)
 
-    return local_train
+    if not megabatch:
+        return local_train
+
+    if batch_axis is not None:
+        # config.validate() mirrors this: the flattened [C·batch] rows
+        # ARE the axis a batch-sharded mesh splits
+        raise ValueError(
+            "megabatch local training is incompatible with a batch mesh "
+            "axis (run.batch_shards > 1)"
+        )
+
+    def local_train_block(global_params, train_x, train_y, idx, mask, keys,
+                          lr_scale=None, grad_corr=None):
+        """Megabatched block trainer — see the factory docstring.
+        idx/mask: [C, steps, batch]; keys: [C, 2] per-client round keys
+        (the engine's `_cohort_keys` chunk)."""
+        if grad_corr is not None:
+            raise ValueError(
+                "megabatch block training does not support grad_corr "
+                "(stateful algorithms are spatial-layout only)"
+            )
+        global_params = _cast_params(global_params)
+        step = _make_step(global_params, train_x, train_y, lr_scale, None)
+        steps = idx.shape[1]
+        # identical per-client key derivation as the per-client path:
+        # split(rng_c, steps), consumed in step order
+        step_keys = jax.vmap(lambda k: jax.random.split(k, steps))(keys)
+        base_state = _base_opt_state(global_params)
+        # Shared-weight phase (step 0): params AND the fresh optimizer
+        # state are replicated across the block — only the data is
+        # batched — so XLA sees the forward / activation-gradient
+        # contractions as single [C·batch, ...] × [..., d] GEMMs
+        # against ONE weight. No vary0 tie-in needed here: the carry
+        # leaves the vmap already data-derived (device-varying).
+        carry0, wl0 = jax.vmap(
+            lambda i, m, k: step((global_params, base_state), (i, m, k))
+        )(idx[:, 0], mask[:, 0], step_keys[:, 0])
+        if steps > 1:
+            # diverged phase: per-client params — the lane-local vmap
+            # (one batched GEMM per layer) over the SAME step fn
+            def scan_body(carry, inp):
+                return jax.vmap(step)(carry, inp)
+
+            xs = jax.tree.map(
+                lambda a: jnp.swapaxes(a[:, 1:], 0, 1),
+                (idx, mask, step_keys),
+            )
+            (params_c, _), wls = jax.lax.scan(
+                scan_body, carry0, xs, unroll=scan_unroll
+            )
+            weighted_losses = jnp.concatenate([wl0[None], wls], axis=0)
+        else:
+            params_c = carry0[0]
+            weighted_losses = wl0[None]
+        n = jax.vmap(_global_count)(mask)
+        mean_loss = weighted_losses.sum(0) / jnp.maximum(n, 1.0)
+        return params_c, LocalMetrics(loss=mean_loss, examples=n)
+
+    return local_train_block
 
 
 def make_eval_fn(model, task: str):

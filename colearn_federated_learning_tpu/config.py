@@ -765,8 +765,38 @@ class RunConfig:
     batch_shards: int = 1
     # clients trained as one vmap block per lane (effective batch =
     # width × batch_size keeps the MXU fed for small models); 1 = pure
-    # sequential scan (min memory), 0 = whole lane in one vmap
+    # sequential scan (min memory), 0 = whole lane in one vmap.
+    # Ignored under cohort_layout="megabatch" (the layout owns the
+    # in-lane batching; an explicit width >= 2 is rejected).
     client_vmap_width: int = 1
+    # Cohort layout (parallel/round_engine.py, client/trainer.py):
+    #   spatial   — the classic placement: the cohort shards over lanes
+    #               and each lane trains its clients in client_vmap_width
+    #               blocks. With width 1 every per-chip GEMM is capped at
+    #               ONE client's batch — the MXU starves on small models.
+    #   megabatch — collapse the cohort axis into the GEMM batch: a lane
+    #               owns K_local = cohort/lanes clients and their local
+    #               training runs as ONE fused block. The first local
+    #               step (all clients still hold the round's identical
+    #               broadcast weights) runs as a true megabatch — the
+    #               forward and activation-gradient GEMMs contract
+    #               [K_local·batch, ...] activations against ONE weight
+    #               — and the remaining steps run as a lane-local vmap
+    #               over the diverged per-client params (one batched
+    #               GEMM per layer instead of K_local sequential
+    #               launches). A pure performance knob: the wire shapes
+    #               ([K] weights, [K,2] mask specs, the [K,·] upload
+    #               stack, psum/robust-reduce aggregation, ledger stats)
+    #               are unchanged and megabatch ≡ spatial is parity-
+    #               pinned (tests/test_round_engine.py). Rejected
+    #               pairings in validate(): stateful algorithms
+    #               (scaffold/feddyn own per-client correction trees in
+    #               the scan layout), gossip/fedbuff (their engines own
+    #               the round shape), and run.batch_shards > 1 (the
+    #               flattened [K_local·batch] megabatch rows are exactly
+    #               the axis the batch mesh splits). The sequential
+    #               engine is layout-free (it IS the oracle).
+    cohort_layout: str = "spatial"  # spatial | megabatch
     # Unroll factor for the client's local-step lax.scan (jax's native
     # `unroll=`): >1 trades compile time / code size for fewer loop
     # iterations and cross-step fusion opportunities; lax.scan handles
@@ -1311,6 +1341,55 @@ class ExperimentConfig:
             )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
+        if self.run.cohort_layout not in ("spatial", "megabatch"):
+            raise ValueError(
+                f"unknown run.cohort_layout {self.run.cohort_layout!r}; "
+                f"allowed: spatial | megabatch"
+            )
+        if self.run.cohort_layout == "megabatch":
+            if self.algorithm in ("scaffold", "feddyn"):
+                # the stateful algorithms thread per-client correction
+                # trees (c − cᵢ / −gᵢ) through the per-block vmap; the
+                # megabatch block trains the whole lane from ONE shared
+                # weight replica at step 0, which has no per-client
+                # correction slot — and their f32-trajectory constraints
+                # make the layout's bf16 megabatch target moot anyway
+                raise ValueError(
+                    f"run.cohort_layout='megabatch' is incompatible with "
+                    f"algorithm={self.algorithm!r} (stateful per-client "
+                    f"correction trees are threaded through the spatial "
+                    f"per-block scan)"
+                )
+            if self.algorithm in ("gossip", "fedbuff"):
+                # their engines own the round shape (replica stack /
+                # staleness ring) — there is no lane-owned cohort block
+                # to megabatch
+                raise ValueError(
+                    f"run.cohort_layout='megabatch' is incompatible with "
+                    f"algorithm={self.algorithm!r} (no lane-owned cohort "
+                    f"block; the gossip/fedbuff engines own the round "
+                    f"shape)"
+                )
+            if self.run.batch_shards > 1:
+                # the megabatch flattens [K_local, batch] into the GEMM
+                # row axis — exactly the axis a batch-sharded mesh
+                # splits across chips; the two layouts are rivals for
+                # the same dimension
+                raise ValueError(
+                    "run.cohort_layout='megabatch' is incompatible with "
+                    "run.batch_shards > 1 (the megabatch rows are the "
+                    "axis the batch mesh shards)"
+                )
+            if self.run.client_vmap_width >= 2:
+                # the layout owns the in-lane batching (whole lane as
+                # one block); a narrower explicit width would silently
+                # contradict it — reject rather than reinterpret
+                raise ValueError(
+                    f"run.cohort_layout='megabatch' owns the in-lane "
+                    f"batching (the whole lane trains as one block); "
+                    f"leave run.client_vmap_width at 1 or 0, got "
+                    f"{self.run.client_vmap_width}"
+                )
         if self.run.scan_unroll < 1:
             raise ValueError(
                 f"run.scan_unroll must be >= 1, got {self.run.scan_unroll}"
@@ -1950,7 +2029,13 @@ def _cifar10_fedavg_100() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
         server=ServerConfig(num_rounds=500, cohort_size=16, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+        # megabatch cohort layout (r12): on one chip the whole cohort-16
+        # block trains as one fused step — the shared-weight first step
+        # feeds the MXU [16·64 = 1024]-row GEMMs where the spatial scan
+        # capped every matmul at one client's 64 — the structural answer
+        # to the 41.4% MFU plateau (BENCH_r01–r05; ROADMAP item 1)
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
+                      cohort_layout="megabatch"),
     )
 
 
@@ -1980,7 +2065,8 @@ def _cifar10_fedavg_1000() -> ExperimentConfig:
         ),
         client=ClientConfig(local_epochs=1, batch_size=64, lr=0.05),
         server=ServerConfig(num_rounds=1000, cohort_size=64, eval_every=20),
-        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
+                      cohort_layout="megabatch"),
     )
 
 
@@ -2002,7 +2088,8 @@ def _femnist_fedprox_500() -> ExperimentConfig:
         # memory-bound so gains are shallow; 32 takes the +17% without
         # an extreme participation ratio (BASELINE.md r5)
         server=ServerConfig(num_rounds=500, cohort_size=32, eval_every=10),
-        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
+                      cohort_layout="megabatch"),
     )
 
 
@@ -2031,12 +2118,13 @@ def _shakespeare_fedavg() -> ExperimentConfig:
         # a sane 25% participation ratio (BASELINE.md r5). fuse=10
         # divides num_rounds and eval_every (chunk-boundary cadence).
         server=ServerConfig(num_rounds=200, cohort_size=32, eval_every=10),
-        # width=0 = whole lane as one vmap block: BERT-tiny at batch 16
-        # starves the MXU, and the r4 sweep measured a monotone
-        # device-time win 7.0 → 6.24 ms/round from widening to the full
-        # lane (BASELINE.md r4); 0 adapts to any lane count.
+        # megabatch layout (r12) supersedes the r4 client_vmap_width=0
+        # adoption: the whole-lane vmap was worth 7.0 → 6.24 ms/round
+        # (BASELINE.md r4); the layout keeps that batched-GEMM shape for
+        # the diverged steps AND runs the shared-weight first step as a
+        # true [K_local·16]-row megabatch against unbatched weights.
         run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
-                      client_vmap_width=0, fuse_rounds=10),
+                      cohort_layout="megabatch", fuse_rounds=10),
     )
 
 
@@ -2114,7 +2202,11 @@ def _cifar10_krum_byzantine() -> ExperimentConfig:
             aggregator="krum", krum_byzantine=2,
         ),
         attack=AttackConfig(kind="sign_flip", fraction=0.125, scale=10.0),
-        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
+        # megabatch composes with the attacked krum path (the wire stack
+        # and robust selection see identical [K, ·] shapes either way —
+        # parity-pinned in tests/test_round_engine.py)
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
+                      cohort_layout="megabatch"),
     )
 
 
@@ -2154,8 +2246,52 @@ def _bert_lora_federated() -> ExperimentConfig:
             num_rounds=200, cohort_size=32, eval_every=10,
             sampling="streaming",
         ),
+        # megabatch (r12) supersedes client_vmap_width=0: under LoRA the
+        # adapters ARE the params, so the shared-weight first step
+        # megabatches the whole frozen-base forward at [K_local·16] rows
         run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16",
-                      client_vmap_width=0),
+                      cohort_layout="megabatch"),
+    )
+
+
+def _vit_lora_dp() -> ExperimentConfig:
+    """Beyond-reference (ROADMAP item 3 follow-up): the cross-silo ViT
+    workload on ADAPTER uploads with example-level DP — ``vit_b16``'s
+    LoRA injection map (models/lora.py ``LORA_SUPPORTED``) finally
+    exercised by a named config. Each of 32 silos trains rank-4
+    attention adapters over the frozen ViT-B/16 base under DP-SGD
+    (per-example clipping + noise act on the ADAPTER gradients — the
+    released coordinates are the ~590k-coordinate adapter subspace
+    instead of the 86M-param full model, which both shrinks the wire
+    message and concentrates the privacy budget on what actually
+    ships). Two-pass clipping keeps the per-example backward
+    MXU-batched at 224px. Layout stays spatial: DP's per-example
+    gradients multiply activation memory by the microbatch, so a
+    cohort-wide megabatch block would trade the MXU win for an HBM
+    cliff on this model."""
+    return ExperimentConfig(
+        name="vit_lora_dp",
+        algorithm="fedavg",
+        model=ModelConfig(
+            name="vit_b16", num_classes=1000, kwargs={"image_size": 224},
+            lora=LoRAConfig(enabled=True, rank=4, alpha=8.0,
+                            target="attention"),
+        ),
+        data=DataConfig(
+            name="imagenet_federated",
+            num_clients=32,
+            partition="silo",
+            max_examples_per_client=1024,
+        ),
+        # adamw on the factor pair (the Hu et al. recipe); adapter-space
+        # steps move a small subspace, so the lr sits above the
+        # full-model silo config's 0.003
+        client=ClientConfig(local_epochs=1, batch_size=64, lr=0.01,
+                            optimizer="adamw"),
+        server=ServerConfig(num_rounds=100, cohort_size=32, eval_every=5),
+        dp=DPConfig(enabled=True, l2_clip=1.0, noise_multiplier=0.8,
+                    microbatch_size=16, clipping="two_pass"),
+        run=RunConfig(compute_dtype="bfloat16", local_param_dtype="bfloat16"),
     )
 
 
@@ -2169,6 +2305,7 @@ _NAMED = {
     "cifar10_gossip_16": _cifar10_gossip_16,
     "cifar10_krum_byzantine": _cifar10_krum_byzantine,
     "bert_lora_federated": _bert_lora_federated,
+    "vit_lora_dp": _vit_lora_dp,
 }
 
 

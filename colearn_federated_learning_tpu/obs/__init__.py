@@ -61,11 +61,15 @@ from colearn_federated_learning_tpu.obs.population import (  # noqa: F401
     SpaceSavingSketch,
 )
 from colearn_federated_learning_tpu.obs.roofline import (  # noqa: F401
+    MXU_TILE_ROWS,
     PEAK_BF16_FLOPS,
     PEAK_F32_FLOPS,
     PEAK_HBM_BYTES_PER_SEC,
+    analytic_lora_step_flops,
     analytic_step_flops,
+    layout_gemm_rows,
     mfu_basis,
+    mxu_tile_pad_fraction,
     round_phase_costs,
     waterfall,
 )

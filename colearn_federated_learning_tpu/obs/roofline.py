@@ -135,6 +135,66 @@ def analytic_step_flops(n_coords: int, batch_units: int) -> int:
     return 6 * int(n_coords) * int(batch_units)
 
 
+def analytic_lora_step_flops(full_coords: int, adapter_coords: int,
+                             batch_units: int) -> int:
+    """Adapter-step FLOPs under a frozen LoRA base (``model.lora``):
+    the forward and the backward's activation-gradient chain still
+    traverse the FULL merged model (2·P_full·B each — gradients must
+    propagate through frozen layers to reach earlier adapters), but
+    weight-gradient contractions exist only for the trainable factors
+    (2·P_adapter·B). Total ``4·P_full·B + 2·P_adapter·B`` — vs full
+    training's ``6·P_full·B`` and vs the naive adapter-only count
+    ``6·P_adapter·B``, which understates a LoRA step by ~P_full/P_adapter.
+    Modeling either endpoint would mis-attribute the MFU waterfall for
+    every adapter config; this is the honest middle the frozen-base
+    structure actually executes."""
+    return (4 * int(full_coords) + 2 * int(adapter_coords)) * int(batch_units)
+
+
+# ---------------------------------------------------------------------------
+# cohort-layout GEMM geometry (run.cohort_layout)
+# ---------------------------------------------------------------------------
+
+# The MXU retires 128×128 tiles; a GEMM whose row count (the activation/
+# batch dim, M) is not a tile multiple pads the last tile with dead rows.
+MXU_TILE_ROWS = 128
+
+COHORT_LAYOUTS = ("spatial", "megabatch")
+
+
+def layout_gemm_rows(cohort_layout: str, clients_per_lane: int,
+                     batch: int) -> int:
+    """The M rows a shared-weight train-step GEMM feeds the MXU under a
+    cohort layout. ``spatial`` trains clients as separate (or batched)
+    per-client GEMMs — batched dot dimensions do NOT merge into M, so
+    every GEMM's rows are ONE client's batch regardless of
+    ``client_vmap_width``; that cap is exactly why the layout, not the
+    width, is the structural lever. ``megabatch`` flattens the lane's
+    whole client chunk into the row axis: M = K_local·batch."""
+    if cohort_layout not in COHORT_LAYOUTS:
+        raise ValueError(
+            f"unknown cohort_layout {cohort_layout!r}; "
+            f"allowed: {', '.join(COHORT_LAYOUTS)}"
+        )
+    if cohort_layout == "megabatch":
+        return int(clients_per_lane) * int(batch)
+    return int(batch)
+
+
+def mxu_tile_pad_fraction(gemm_rows: int, tile: int = MXU_TILE_ROWS) -> float:
+    """Fraction of the MXU's row-tile slots wasted on padding when a
+    GEMM with ``gemm_rows`` rows is tiled: ``1 − rows/(⌈rows/tile⌉·tile)``.
+    Batch 32 under the spatial layout wastes 0.75 of every row tile;
+    a 16-client megabatch at the same batch (512 rows) wastes 0.0 —
+    the tile-level attribution of the layout's MFU win (`colearn mfu`
+    prints it next to the waterfall)."""
+    rows = int(gemm_rows)
+    if rows <= 0:
+        raise ValueError(f"gemm_rows must be > 0, got {gemm_rows}")
+    tiles = -(-rows // int(tile))
+    return 1.0 - rows / float(tiles * int(tile))
+
+
 # ---------------------------------------------------------------------------
 # the analytic per-phase cost model
 # ---------------------------------------------------------------------------
@@ -429,6 +489,14 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "identity_violations": check_waterfall_identity(wf),
         "roofline": roofline,
         "host_exposed_ms_per_round": host_ms,
+        # cohort-layout attribution (runs predating the layout fields
+        # render n/a — never a KeyError)
+        "layout": {
+            "cohort_layout": model.get("cohort_layout"),
+            "clients_per_lane": model.get("clients_per_lane"),
+            "gemm_rows": model.get("gemm_rows"),
+            "mxu_tile_pad_fraction": model.get("mxu_tile_pad_fraction"),
+        },
     }
 
 
@@ -454,6 +522,16 @@ def format_mfu_report(report: Dict[str, Any], path: str = "") -> str:
         f"{report['n_chips']} chip(s), {report['flop_source']} flops)"
     )
     lines.append(f"headline MFU: {wf['headline_mfu_pct']:.2f}%")
+    lay = report.get("layout") or {}
+    if lay.get("cohort_layout"):
+        pad = lay.get("mxu_tile_pad_fraction")
+        lines.append(
+            f"cohort layout: {lay['cohort_layout']}  "
+            f"(K_local {_na(lay.get('clients_per_lane'))}, "
+            f"gemm rows {_na(lay.get('gemm_rows'))}, "
+            f"mxu row-tile padding "
+            f"{_na(None if pad is None else 100.0 * pad, '{:.1f}%')})"
+        )
     lines.append("")
     lines.append(f"waterfall (% of wall time, sums to 100 "
                  f"± {WATERFALL_TOL_PCT}):")
@@ -535,8 +613,71 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
             "timed_rounds": timed,
             "phase_ms_per_round": phase_ms_per_round,
             "padded_step_fraction": extra.get("padded_step_fraction"),
+            # the n_chips axis (weak-scaling bench): historical entries
+            # that predate it render n/a like every other field
+            "n_chips": extra.get("n_chips"),
+            "updates_per_sec_per_chip": extra.get(
+                "client_updates_per_sec_per_chip"
+            ),
+            "cohort_layout": extra.get("cohort_layout"),
+            "weak_scale": _tail_weak_scale_records(doc, parsed),
         })
     return entries
+
+
+def _tail_weak_scale_records(doc, parsed) -> List[Dict[str, Any]]:
+    """weak_scale_* bench records carried by one BENCH_r*.json — either
+    the file's own parsed entry (a dedicated weak-scale run) or extra
+    JSON lines in its raw ``tail`` (a ``--matrix`` run prints one line
+    per config; ``parsed`` keeps only the last). Normalized to the few
+    fields the weak-scaling report needs; anything unparsable or
+    missing fields is skipped, never a KeyError — the r01+ history
+    predates weak scaling entirely and must keep loading clean."""
+    records = []
+    candidates: List[Dict[str, Any]] = []
+    for line in str(doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and "weak_scale" in line):
+            continue
+        try:
+            candidates.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if (
+        "weak_scale" in str(parsed.get("metric") or "")
+        or str(parsed.get("config") or "").startswith("weak_scale")
+        # a direct `bench.py --config weak_scale_*` record carries no
+        # `config` key and its metric reads "weak scaling: ..." — the
+        # per-chip-cohort extra is the reliable marker
+        or (parsed.get("extra") or {}).get("weak_scale_per_chip_cohort")
+        is not None
+    ):
+        candidates.append(parsed)
+    seen = set()
+    for rec in candidates:
+        extra = rec.get("extra") or {}
+        per_chip = extra.get("weak_scale_per_chip_cohort")
+        name = rec.get("config") or extra.get("weak_scale_name") or (
+            f"weak_scale_{per_chip}" if per_chip is not None
+            else rec.get("metric")
+        )
+        ups = extra.get("client_updates_per_sec_per_chip")
+        chips = extra.get("n_chips")
+        if name is None or ups is None or chips is None:
+            continue
+        key = (str(name), int(chips))
+        if key in seen:
+            continue
+        seen.add(key)
+        records.append({
+            "name": str(name),
+            "n_chips": int(chips),
+            "per_chip_cohort": per_chip,
+            "cohort_size": extra.get("cohort_size"),
+            "updates_per_sec_per_chip": float(ups),
+            "cohort_layout": extra.get("cohort_layout"),
+        })
+    return records
 
 
 DEFAULT_PHASE_REGRESSION_FACTOR = 1.25
@@ -607,7 +748,49 @@ def bench_report(entries: Sequence[Dict[str, Any]],
         "latest": latest,
         "best_phase_ms": best_phase,
         "violations": violations,
+        "weak_scaling": weak_scaling_report(entries),
     }
+
+
+def weak_scaling_report(entries: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Weak-scaling efficiency over the history's ``weak_scale_*``
+    records: updates/sec/chip at each chip count relative to the same
+    per-chip-cohort workload's 1-chip pin (ideal weak scaling holds
+    efficiency at 1.0 as chips × cohort grow together). Groups by
+    per-chip cohort; when no 1-chip measurement exists yet the
+    smallest-chip-count record becomes the pin (recorded as
+    ``pin_n_chips`` so the readout stays honest). Empty list when the
+    history carries no weak_scale entries — the r01+ era — which
+    formats as ``n/a``, never an error."""
+    groups: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in entries:
+        for r in e.get("weak_scale") or []:
+            key = r.get("per_chip_cohort")
+            if key is None:
+                key = r.get("name")
+            groups.setdefault(key, []).append(dict(r, file=e.get("file")))
+    out: List[Dict[str, Any]] = []
+    for key in sorted(groups, key=str):
+        recs = groups[key]
+        pins = [r for r in recs if r.get("n_chips") == 1]
+        pin = pins[-1] if pins else min(recs, key=lambda r: r["n_chips"])
+        pin_ups = pin["updates_per_sec_per_chip"]
+        for r in sorted(recs, key=lambda r: (r["n_chips"], str(r.get("file")))):
+            out.append({
+                "group": key,
+                "name": r.get("name"),
+                "file": r.get("file"),
+                "n_chips": r["n_chips"],
+                "cohort_size": r.get("cohort_size"),
+                "updates_per_sec_per_chip": r["updates_per_sec_per_chip"],
+                "cohort_layout": r.get("cohort_layout"),
+                "pin_n_chips": pin["n_chips"],
+                "efficiency": (
+                    r["updates_per_sec_per_chip"] / pin_ups
+                    if pin_ups else None
+                ),
+            })
+    return out
 
 
 def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
@@ -620,6 +803,7 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
     lines.append(
         f"{'entry':<18}{'r/s':>8}{'vs_base':>9}{'mfu%':>8}"
         f"{'basis':>11}{'dtype':>10}{'dev ms':>8}"
+        f"{'chips':>7}{'upd/s/chip':>12}"
     )
     for e in entries:
         lines.append(
@@ -630,6 +814,8 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
             f"{_na(e.get('mfu_basis')):>11}"
             f"{_na(e.get('compute_dtype')):>10}"
             f"{_na(e.get('device_ms_per_round'), '{:.1f}'):>8}"
+            f"{_na(e.get('n_chips')):>7}"
+            f"{_na(e.get('updates_per_sec_per_chip'), '{:.1f}'):>12}"
         )
     latest = report.get("latest")
     phases = (latest or {}).get("phase_ms_per_round")
@@ -649,6 +835,25 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
     elif latest is not None:
         lines.append("")
         lines.append("per-phase ms: n/a (history predates phase_ms extras)")
+    ws = report.get("weak_scaling") or []
+    lines.append("")
+    if ws:
+        lines.append("weak scaling (updates/sec/chip vs the pin):")
+        for r in ws:
+            eff = _na(r.get("efficiency"), "{:.2f}")
+            note = (
+                "" if r.get("pin_n_chips") == 1
+                else f"  [pin: {r['pin_n_chips']}-chip]"
+            )
+            lines.append(
+                f"  {str(r.get('name')):<22}{r['n_chips']:>3} chip(s)"
+                f"{r['updates_per_sec_per_chip']:>12.1f} upd/s/chip"
+                f"   eff {eff}{note}"
+            )
+    else:
+        lines.append(
+            "weak scaling: n/a (no weak_scale_* entries in this history)"
+        )
     lines.append("")
     if report["violations"]:
         lines.append("GATE FAILURES:")

@@ -1,9 +1,18 @@
 """Device-mesh construction for client-parallel FL simulation.
 
 One chip == one virtual-client lane (the north star, BASELINE.json:5).
-With cohort_size K and L lanes, each lane trains K/L clients
-sequentially per round under ``lax.scan``; the weighted aggregation is a
-``psum`` over the ``"clients"`` mesh axis.
+With cohort_size K and L lanes, each lane owns K_local = K/L cohort
+members (:func:`lane_client_count`); the weighted aggregation is a
+``psum`` over the ``"clients"`` mesh axis either way. HOW a lane trains
+its K_local clients is the cohort layout (``run.cohort_layout``,
+parallel/round_engine.py): ``spatial`` scans/vmaps them in
+``client_vmap_width`` blocks — every per-chip GEMM capped at one
+client's batch — while ``megabatch`` collapses the lane's whole client
+chunk into the GEMM batch (shared-weight first step at
+``[K_local·batch]`` rows, lane-local vmap for the diverged steps) so
+the MXU sees production-sized matmuls. The layout changes nothing
+about the mesh or the sharding rules below: cohort tensors stay
+``P(clients, ...)``, params/metrics stay replicated.
 
 All code is size-agnostic (SURVEY.md §7 "hard parts"): the same mesh
 builds over 1 real TPU chip, 8 fake CPU devices, or a v4-32 pod slice.
@@ -52,6 +61,19 @@ def build_client_mesh(num_lanes: int = 0, devices=None, batch_shards: int = 1) -
         np.array(devices[:need]).reshape(num_lanes, batch_shards),
         (CLIENT_AXIS, BATCH_AXIS),
     )
+
+
+def lane_client_count(cohort_size: int, mesh: Mesh) -> int:
+    """K_local: how many cohort members one lane owns under this mesh —
+    the megabatch layout's block size (and the spatial layout's maximum
+    ``client_vmap_width``). The cohort must split evenly over lanes
+    (static shapes; the engine enforces the same rule)."""
+    lanes = int(mesh.shape[CLIENT_AXIS])
+    if cohort_size % lanes:
+        raise ValueError(
+            f"cohort {cohort_size} not divisible by {lanes} lanes"
+        )
+    return cohort_size // lanes
 
 
 def has_batch_axis(mesh: Mesh) -> bool:

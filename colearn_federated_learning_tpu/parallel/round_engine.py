@@ -169,7 +169,7 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          downlink="", secagg_quant_step=0.0,
                          error_feedback=False, attack="",
                          client_ledger=False, reputation=False,
-                         fused_apply=False):
+                         fused_apply=False, cohort_layout="spatial"):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -328,6 +328,21 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
         raise ValueError(
             "fused_apply is incompatible with stateful algorithms "
             "(they own the server step)"
+        )
+    if cohort_layout not in ("spatial", "megabatch"):
+        raise ValueError(
+            f"unknown cohort_layout {cohort_layout!r}; "
+            f"allowed: spatial | megabatch"
+        )
+    if cohort_layout == "megabatch" and (scaffold or feddyn):
+        # mirror config.validate(): the stateful per-client correction
+        # trees (c − cᵢ / −gᵢ) ride the spatial per-block vmap; the
+        # megabatch block trains from ONE shared weight replica at step
+        # 0 and has no per-client correction slot
+        raise ValueError(
+            "cohort_layout='megabatch' is incompatible with stateful "
+            "algorithms (their per-client correction trees ride the "
+            "spatial per-block scan)"
         )
     if reputation and not client_ledger:
         # mirror config.validate(): the trust weights are a pure
@@ -615,8 +630,30 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           rep_floor: float = 0.05,
                           rep_strength: float = 6.0,
                           rep_z_gain: float = 1.0,
-                          fused_apply: bool = False):
+                          fused_apply: bool = False,
+                          cohort_layout: str = "spatial"):
     """Build the jitted one-program round function.
+
+    ``cohort_layout`` (``run.cohort_layout``): ``"spatial"`` is the
+    classic placement — each lane trains its K/L clients in
+    ``client_vmap_width`` blocks, so with width 1 every per-chip GEMM
+    is capped at one client's batch. ``"megabatch"`` collapses the
+    cohort axis into the GEMM batch: the lane's whole client chunk
+    trains as ONE block (``client_vmap_width`` is owned by the layout),
+    with the first local step run from the REPLICATED round weights so
+    its forward/activation-gradient GEMMs contract the flattened
+    ``[K_local·batch, ...]`` megabatch against one un-batched weight,
+    and the remaining (diverged-weights) steps scanned as a lane-local
+    vmap — one batched GEMM per layer instead of K_local sequential
+    launches (client/trainer.py ``megabatch``). Purely a performance
+    layout: every wire shape — the ``[K]`` weights/participation, the
+    ``[K, 2]`` on-device mask spec, the ``[K, ·]`` upload stack, the
+    psum/robust-reduce aggregation contract, ledger stats — is
+    unchanged, and megabatch ≡ spatial is parity-pinned across
+    aggregators × attacks × EF × fuse_rounds
+    (tests/test_round_engine.py). Incompatible with stateful
+    algorithms (``_check_engine_compat``) and batch-sharded meshes
+    (the flattened rows are the axis the batch mesh splits).
 
     Signature of the returned fn::
 
@@ -783,7 +820,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                          secagg_quant_step=secagg_quant_step,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
-                         reputation=reputation, fused_apply=fused_apply)
+                         reputation=reputation, fused_apply=fused_apply,
+                         cohort_layout=cohort_layout)
     if fused_apply and not hasattr(server_update, "fused_reduce"):
         # the stacked-path kernel entry lives on the fused server
         # update (make_server_update_fn with cfg.fused_apply) — a
@@ -808,22 +846,36 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             f"batch_size {client_cfg.batch_size} not divisible by "
             f"{mesh.shape[BATCH_AXIS]} batch shards"
         )
+    megabatch = cohort_layout == "megabatch"
+    if megabatch and batch_sharded:
+        # mirror config.validate(): the flattened [K_local·batch] rows
+        # ARE the axis the batch mesh shards
+        raise ValueError(
+            "cohort_layout='megabatch' is incompatible with a "
+            "batch-sharded mesh (run.batch_shards > 1)"
+        )
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task,
         batch_axis=BATCH_AXIS if batch_sharded else None,
         local_dtype=local_dtype, scan_unroll=scan_unroll,
+        megabatch=megabatch,
     )
     n_lanes = mesh.shape[CLIENT_AXIS]
     if cohort_size % n_lanes != 0:
         raise ValueError(f"cohort {cohort_size} not divisible by lanes {n_lanes}")
     clients_per_lane = cohort_size // n_lanes
-    width = client_vmap_width if client_vmap_width > 0 else clients_per_lane
-    if width > clients_per_lane or clients_per_lane % width != 0:
-        raise ValueError(
-            f"client_vmap_width {width} must divide the {clients_per_lane} "
-            f"clients per lane (cohort {cohort_size} / {n_lanes} lanes); "
-            f"use 0 for the full lane"
-        )
+    if megabatch:
+        # the layout owns the in-lane batching: the whole lane is one
+        # block (config.validate rejects an explicit width >= 2)
+        width = clients_per_lane
+    else:
+        width = client_vmap_width if client_vmap_width > 0 else clients_per_lane
+        if width > clients_per_lane or clients_per_lane % width != 0:
+            raise ValueError(
+                f"client_vmap_width {width} must divide the {clients_per_lane} "
+                f"clients per lane (cohort {cohort_size} / {n_lanes} lanes); "
+                f"use 0 for the full lane"
+            )
 
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
@@ -972,6 +1024,21 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         if stateful:
             c_global = _pcast_varying(c_global)
 
+        def _train_block(p, b_idx, b_mask, b_keys, extra):
+            """One client block through local training. The megabatch
+            layout hands the whole block to the fused block trainer
+            (shared-weight step 0 at [C·batch] rows + lane-local vmap);
+            the spatial layout vmaps the per-client fn over the block —
+            the same per-client step body either way."""
+            if megabatch:
+                return local_train(
+                    p, train_x, train_y, b_idx, b_mask, b_keys, *extra
+                )
+            return jax.vmap(
+                local_train,
+                in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
+            )(p, train_x, train_y, b_idx, b_mask, b_keys, *extra)
+
         def per_block(acc, inp):
             b_tr = None
             if reputation:
@@ -986,10 +1053,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 # is plain (the memory only touches the upload)
                 b_idx, b_mask, b_n, b_keys, b_c = inp
                 extra = () if lr_scale is None else (lr_scale,)
-                w_b, m_b = jax.vmap(
-                    local_train,
-                    in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
-                )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
+                w_b, m_b = _train_block(params, b_idx, b_mask, b_keys, extra)
             elif stateful:
                 b_idx, b_mask, b_n, b_keys, b_c = inp
                 if scaffold:
@@ -1009,10 +1073,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 else:
                     b_idx, b_mask, b_n, b_keys = inp
                 extra = () if lr_scale is None else (lr_scale,)
-                w_b, m_b = jax.vmap(
-                    local_train,
-                    in_axes=(None, None, None, 0, 0, 0) + (None,) * len(extra),
-                )(params, train_x, train_y, b_idx, b_mask, b_keys, *extra)
+                w_b, m_b = _train_block(params, b_idx, b_mask, b_keys, extra)
             # FedAvg weight per client: example count, or participation
             # (n>0) under "uniform" — dropout zeroing propagates either way
             b_w = b_n if agg == "examples" else (b_n > 0).astype(b_n.dtype)
@@ -1884,7 +1945,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                              rep_floor: float = 0.05,
                              rep_strength: float = 6.0,
                              rep_z_gain: float = 1.0,
-                             fused_apply: bool = False):
+                             fused_apply: bool = False,
+                             cohort_layout: str = "spatial"):
     """Reference-semantics engine: python loop over the cohort, jitted
     per-client local training, host-side weighted mean. Used for
     single-device debugging and as the parity oracle the shard_map
@@ -1900,7 +1962,11 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     ``client_ledger`` mirrors the sharded engine: the round fn takes
     ``ledger`` + ``ledger_ids`` and returns the updated ledger before
     the metrics, built from the SAME shared stats/update helpers
-    (obs/ledger.py) over the same wire-upload stack."""
+    (obs/ledger.py) over the same wire-upload stack.
+    ``cohort_layout`` is accepted for signature symmetry and validated
+    through the shared compat mirror, but the oracle itself is
+    layout-free: the python loop IS the reference semantics both
+    layouts must reproduce."""
     if agg not in ("examples", "uniform"):
         raise ValueError(f"unknown aggregation mode {agg!r}")
     _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
@@ -1909,7 +1975,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                          secagg_quant_step=secagg_quant_step,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
-                         reputation=reputation, fused_apply=fused_apply)
+                         reputation=reputation, fused_apply=fused_apply,
+                         cohort_layout=cohort_layout)
     if fused_apply and not hasattr(server_update, "fused_reduce"):
         raise ValueError(
             "fused_apply=True requires a server_update built by "

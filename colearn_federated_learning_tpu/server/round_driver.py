@@ -47,8 +47,11 @@ from colearn_federated_learning_tpu.obs import (
 )
 from colearn_federated_learning_tpu.obs.roofline import (
     PEAK_HBM_BYTES_PER_SEC,
+    analytic_lora_step_flops,
     analytic_step_flops,
+    layout_gemm_rows,
     mfu_basis,
+    mxu_tile_pad_fraction,
     round_phase_costs,
 )
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
@@ -419,6 +422,7 @@ class Experiment:
                         self._poisson_cap or cfg.server.cohort_size,
                         dp_fixed_denom=cfg.server.cohort_size,
                         client_vmap_width=cfg.run.client_vmap_width,
+                        cohort_layout=cfg.run.cohort_layout,
                         local_dtype=self._local_dtype(), agg=agg,
                         scaffold=self.scaffold,
                         num_clients=self.fed.num_clients,
@@ -476,6 +480,7 @@ class Experiment:
             self.round_fn = make_sequential_round_fn(
                 self.model, cfg.client, cfg.dp, self.task, server_update,
                 dp_fixed_denom=cfg.server.cohort_size,
+                cohort_layout=cfg.run.cohort_layout,
                 local_dtype=self._local_dtype(), agg=agg,
                 scaffold=self.scaffold, num_clients=self.fed.num_clients,
                 aggregator=cfg.server.aggregator,
@@ -987,7 +992,20 @@ class Experiment:
                 if flops is not None:
                     source = "xla"
             if flops is None:
-                flops = analytic_step_flops(coords, units)
+                if self._lora:
+                    # adapter-aware step cost (obs/roofline.py): the
+                    # frozen base still runs the forward + the
+                    # activation-gradient backward; only the factor
+                    # weight-gradients are trainable — 6·P_adapter·B
+                    # would understate the step by ~P_full/P_adapter
+                    # and 6·P_full·B would overstate it
+                    full_coords, _ = self._full_param_stats()
+                    flops = analytic_lora_step_flops(
+                        full_coords, coords, units
+                    )
+                    source = "analytic_lora"
+                else:
+                    flops = analytic_step_flops(coords, units)
             self._step_flops_cache = (int(flops), source)
         return self._step_flops_cache
 
@@ -2809,11 +2827,30 @@ class Experiment:
                 cfg.run.compute_dtype, cfg.run.local_param_dtype,
                 cfg.run.param_dtype,
             )
+            # cohort-layout GEMM geometry (obs/roofline.py): the rows
+            # each shared-weight train GEMM feeds the MXU under this
+            # run's layout, and the row-tile padding they waste — the
+            # attribution `colearn mfu` prints next to the waterfall
+            # (the megabatch layout's whole point is driving this pad
+            # fraction to ~0 without touching any wire shape)
+            lanes = (
+                int(self.mesh.shape[mesh_lib.CLIENT_AXIS])
+                if self.mesh is not None else 1
+            )
+            k_round = int(self._poisson_cap or cfg.server.cohort_size)
+            k_local = max(1, k_round // max(1, lanes))
+            rows = layout_gemm_rows(
+                cfg.run.cohort_layout, k_local, cfg.client.batch_size
+            )
             self.logger.log({
                 "event": "phase_cost_model",
                 "step_flops": int(step_flops),
                 "flop_source": flop_source,
                 "n_coords": int(coords),
+                # the FULL model's coordinate count (== n_coords unless
+                # model.lora is on) — the adapter-aware step-FLOP model
+                # is a function of both, so the record carries both
+                "n_coords_full": int(self._full_param_stats()[0]),
                 "param_bytes": int(p_bytes),
                 "compute_bytes": int(self._compute_itemsize()),
                 "mfu_basis": basis,
@@ -2821,6 +2858,12 @@ class Experiment:
                 "peak_hbm_bytes_per_sec": float(PEAK_HBM_BYTES_PER_SEC),
                 "n_chips": int(self.n_chips),
                 "process_index": int(self._process_index),
+                "cohort_layout": cfg.run.cohort_layout,
+                "clients_per_lane": int(k_local),
+                "gemm_rows": int(rows),
+                "mxu_tile_pad_fraction": round(
+                    mxu_tile_pad_fraction(rows), 4
+                ),
             })
         if start_round == 0 and self._poisson:
             self.logger.log({

@@ -53,6 +53,20 @@ _SHRINK = {
         "model.kwargs.seq_len": 16,
         "run.client_vmap_width": 1,
     },
+    # adapter plane × example-DP on the ViT injection map: keeps the
+    # LoRA wrapper, the silo partition, AND the two-pass DP-SGD path;
+    # rank 4 stays low-rank for the shrunk 64-hidden qkv kernels
+    "vit_lora_dp": {
+        "data.num_clients": 8,
+        "server.cohort_size": 8,
+        "model.kwargs.image_size": 32,
+        "model.kwargs.patch_size": 8,
+        "model.kwargs.hidden": 64,
+        "model.kwargs.layers": 2,
+        "model.kwargs.heads": 2,
+        "model.kwargs.mlp_dim": 128,
+        "dp.microbatch_size": 4,
+    },
     "imagenet_silo_dp": {
         "data.num_clients": 8,
         "server.cohort_size": 8,

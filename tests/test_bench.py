@@ -280,3 +280,141 @@ def test_bench_report_explicit_phase_budget_overrides_best(tmp_path):
     })
     assert any("round.dispatch" in v and "explicit" in v
                for v in report["violations"])
+
+
+# ---------------------------------------------------------------------------
+# weak-scaling axis (r12): weak_scale_* entries + the bench-report line
+# ---------------------------------------------------------------------------
+
+
+def test_weak_scale_entries_defined():
+    """The n_chips axis is measurement-ready: cohort-in-the-hundreds
+    per-chip workloads reachable via --config and the matrix, with the
+    per-chip cohort recorded so bench-report can group them."""
+    assert bench._WEAK_SCALE == {
+        "weak_scale_64": 64, "weak_scale_128": 128, "weak_scale_256": 256,
+    }
+
+
+def _weak_record(per_chip, n_chips, ups, config="weak_scale_64"):
+    return {
+        "metric": f"FL rounds/sec (weak scaling: {per_chip}/chip)",
+        "value": 3.0,
+        "unit": "rounds/sec",
+        "vs_baseline": 1.0,
+        "config": config,
+        "extra": {
+            "weak_scale_per_chip_cohort": per_chip,
+            "cohort_size": per_chip * n_chips,
+            "n_chips": n_chips,
+            "client_updates_per_sec_per_chip": ups,
+            "cohort_layout": "megabatch",
+        },
+    }
+
+
+def test_bench_report_weak_scaling_efficiency_line(tmp_path):
+    """A history whose tail carries weak_scale records (matrix-mode
+    output) produces the efficiency line vs the 1-chip pin; the
+    headline entry keeps parsing as before."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    one = _weak_record(64, 1, 400.0)
+    four = _weak_record(64, 4, 300.0)
+    headline = {
+        "metric": "FL rounds/sec (100-client cifar10)",
+        "value": 3.4, "unit": "rounds/sec", "vs_baseline": 1.5,
+        "extra": {"n_chips": 1,
+                  "client_updates_per_sec_per_chip": 54.7,
+                  "cohort_layout": "megabatch"},
+    }
+    doc = {
+        "n": 9,
+        "tail": "\n".join([json.dumps(one), json.dumps(four),
+                           json.dumps(headline)]),
+        "parsed": headline,
+    }
+    with open(os.path.join(str(tmp_path), "BENCH_r09.json"), "w") as f:
+        json.dump(doc, f)
+    entries = roofline.load_bench_history(str(tmp_path))
+    assert len(entries) == 1
+    e = entries[0]
+    # the new columns ride the normalized entry
+    assert e["n_chips"] == 1 and e["updates_per_sec_per_chip"] == 54.7
+    assert e["cohort_layout"] == "megabatch"
+    assert len(e["weak_scale"]) == 2
+    report = roofline.bench_report(entries)
+    ws = report["weak_scaling"]
+    assert [r["n_chips"] for r in ws] == [1, 4]
+    assert ws[0]["efficiency"] == 1.0
+    assert ws[1]["efficiency"] == 300.0 / 400.0
+    assert ws[1]["pin_n_chips"] == 1
+    text = roofline.format_bench_report(report, str(tmp_path))
+    assert "weak scaling" in text and "upd/s/chip" in text
+    assert "eff 0.75" in text
+
+
+def test_bench_report_weak_scaling_na_on_historical_shapes():
+    """The r01-era history has no weak_scale entries anywhere: the
+    report carries an empty weak_scaling list and the formatter prints
+    n/a — never a KeyError (ISSUE 12 satellite)."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    entries = roofline.load_bench_history(_FIXTURE_HISTORY)
+    report = roofline.bench_report(entries)
+    assert report["weak_scaling"] == []
+    text = roofline.format_bench_report(report, _FIXTURE_HISTORY)
+    assert "weak scaling: n/a" in text
+
+
+def test_bench_report_weak_scaling_pin_fallback(tmp_path):
+    """No 1-chip measurement yet: the smallest-chip entry becomes the
+    pin and the readout says so (pin_n_chips) instead of silently
+    normalizing against nothing."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    rec2 = _weak_record(128, 2, 380.0, config="weak_scale_128")
+    rec8 = _weak_record(128, 8, 342.0, config="weak_scale_128")
+    doc = {"n": 10, "tail": json.dumps(rec2) + "\n" + json.dumps(rec8),
+           "parsed": rec8}
+    with open(os.path.join(str(tmp_path), "BENCH_r10.json"), "w") as f:
+        json.dump(doc, f)
+    entries = roofline.load_bench_history(str(tmp_path))
+    report = roofline.bench_report(entries)
+    ws = report["weak_scaling"]
+    assert [r["n_chips"] for r in ws] == [2, 8]
+    assert ws[0]["pin_n_chips"] == 2 and ws[0]["efficiency"] == 1.0
+    assert ws[1]["efficiency"] == 342.0 / 380.0
+
+
+def test_weak_scale_configs_validate_per_chip_count():
+    """Every weak_scale entry's config must validate at 1, 4, and 8
+    chips (construction only — the ResNet run itself is TPU-budget):
+    megabatch layout, cohort = per_chip × n_chips, federation 2× the
+    cohort."""
+    for per_chip in bench._WEAK_SCALE.values():
+        for chips in (1, 4, 8):
+            cfg = bench._weak_scale_cfg(per_chip, chips, 2, 4)
+            assert cfg.run.cohort_layout == "megabatch"
+            assert cfg.server.cohort_size == per_chip * chips
+            assert cfg.data.num_clients == 2 * per_chip * chips
+            assert cfg.server.num_rounds == 6
+
+
+def test_bench_report_weak_scaling_from_direct_run_record(tmp_path):
+    """A dedicated `bench.py --config weak_scale_*` BENCH file (no
+    matrix tail, no `config` key — the driver's single-config shape)
+    still feeds the weak-scaling line: the per-chip-cohort extra is the
+    marker and names the group."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    rec = _weak_record(64, 1, 410.0)
+    del rec["config"]
+    doc = {"n": 11, "tail": json.dumps(rec), "parsed": rec}
+    with open(os.path.join(str(tmp_path), "BENCH_r11.json"), "w") as f:
+        json.dump(doc, f)
+    entries = roofline.load_bench_history(str(tmp_path))
+    ws = roofline.bench_report(entries)["weak_scaling"]
+    assert len(ws) == 1
+    assert ws[0]["name"] == "weak_scale_64"
+    assert ws[0]["efficiency"] == 1.0

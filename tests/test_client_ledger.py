@@ -593,6 +593,13 @@ def _headline_cfg(out, engine):
         "run.metrics_flush_every": 2, "run.out_dir": str(out),
         "run.engine": engine,
         "run.obs.client_ledger.enabled": True,
+        # this smoke pins LEDGER semantics against the layout-free
+        # sequential oracle, so both engines must run the same layout:
+        # the named config ships cohort_layout=megabatch (r12), whose
+        # GEMM reassociation can flip krum's near-tie winner vs the
+        # oracle over 5 rounds, moving every cosine EMA — layout parity
+        # has its own matrix (test_round_engine.py::TestCohortLayout)
+        "run.cohort_layout": "spatial",
     })
     return cfg.validate()
 

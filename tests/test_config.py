@@ -12,7 +12,7 @@ def test_named_configs_exist():
     # BASELINE.json:7-11 — the five capability configs, plus the
     # 1000-client north-star scale config (BASELINE.json:5) and the
     # beyond-reference decentralized / adversarial / adapter-plane
-    # showcases
+    # showcases (vit_lora_dp: the ViT injection map under example-DP)
     assert list_named_configs() == sorted([
         "mnist_fedavg_2",
         "cifar10_fedavg_100",
@@ -23,6 +23,7 @@ def test_named_configs_exist():
         "cifar10_gossip_16",
         "cifar10_krum_byzantine",
         "bert_lora_federated",
+        "vit_lora_dp",
     ])
     for name in list_named_configs():
         cfg = get_named_config(name)

@@ -299,3 +299,128 @@ def test_xla_flop_source_falls_back_or_counts(tmp_path):
     m = next(r for r in recs if r.get("event") == "phase_cost_model")
     assert m["flop_source"] in ("xla", "analytic")
     assert m["step_flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cohort-layout GEMM geometry + adapter-aware LoRA step FLOPs (r12)
+# ---------------------------------------------------------------------------
+
+
+def test_cohort_layout_gemm_geometry_units():
+    from colearn_federated_learning_tpu.obs.roofline import (
+        MXU_TILE_ROWS,
+        layout_gemm_rows,
+        mxu_tile_pad_fraction,
+    )
+
+    assert MXU_TILE_ROWS == 128
+    # spatial: per-GEMM rows are ONE client's batch — batched dot dims
+    # do not merge into M, which is exactly why the layout is the lever
+    assert layout_gemm_rows("spatial", 16, 32) == 32
+    assert layout_gemm_rows("megabatch", 16, 32) == 512
+    with pytest.raises(ValueError, match="cohort_layout"):
+        layout_gemm_rows("ring", 4, 32)
+    assert mxu_tile_pad_fraction(128) == 0.0
+    assert mxu_tile_pad_fraction(512) == 0.0
+    assert mxu_tile_pad_fraction(32) == 0.75
+    assert mxu_tile_pad_fraction(130) == pytest.approx(1.0 - 130.0 / 256.0)
+    with pytest.raises(ValueError, match="gemm_rows"):
+        mxu_tile_pad_fraction(0)
+
+
+def test_lora_step_flops_model():
+    from colearn_federated_learning_tpu.obs.roofline import (
+        analytic_lora_step_flops,
+    )
+
+    # frozen-base fwd + activation-gradient bwd (4·P_full·B) + factor
+    # weight-gradients (2·P_adapter·B)
+    assert analytic_lora_step_flops(100, 10, 32) == (4 * 100 + 2 * 10) * 32
+    # strictly between full training and the naive adapter-only count
+    assert (analytic_lora_step_flops(100, 10, 32)
+            < analytic_step_flops(100, 32))
+    assert (analytic_lora_step_flops(100, 10, 32)
+            > analytic_step_flops(10, 32))
+
+
+def test_megabatch_smoke_roofline_padding_drop(tmp_path):
+    """Tier-1 CPU megabatch smoke (ISSUE 12 acceptance): the layout's
+    phase_cost_model attribution — gemm_rows grows by K_local and the
+    MXU row-tile padding fraction DROPS vs the spatial twin — while
+    the two layouts train the same federation (per-round losses agree;
+    the bitwise params pin lives in tests/test_round_engine.py)."""
+    import numpy as _np
+
+    over = {"run.num_lanes": 1}  # K_local = the whole cohort of 4
+    recs_sp, _ = _fit_records(_cfg(tmp_path / "sp", **over))
+    recs_mb, path_mb = _fit_records(_cfg(
+        tmp_path / "mb", **{**over, "run.cohort_layout": "megabatch"}
+    ))
+    m_sp = next(r for r in recs_sp if r.get("event") == "phase_cost_model")
+    m_mb = next(r for r in recs_mb if r.get("event") == "phase_cost_model")
+    assert m_sp["cohort_layout"] == "spatial"
+    assert m_mb["cohort_layout"] == "megabatch"
+    assert m_sp["n_coords_full"] == m_sp["n_coords"]  # no lora here
+    assert m_mb["clients_per_lane"] == 4
+    assert m_mb["gemm_rows"] == 4 * m_sp["gemm_rows"]
+    # THE smoke assertion: megabatch reclaims MXU row-tile padding
+    assert (m_mb["mxu_tile_pad_fraction"]
+            < m_sp["mxu_tile_pad_fraction"])
+    # batch 16 spatial → 1 - 16/128; megabatch 64 rows → 1 - 64/128
+    assert m_sp["mxu_tile_pad_fraction"] == pytest.approx(0.875)
+    assert m_mb["mxu_tile_pad_fraction"] == pytest.approx(0.5)
+    # same federation, same trajectory: per-round losses agree
+    loss_sp = [r["train_loss"] for r in recs_sp
+               if r.get("event") is None and "train_loss" in r]
+    loss_mb = [r["train_loss"] for r in recs_mb
+               if r.get("event") is None and "train_loss" in r]
+    assert loss_sp and len(loss_sp) == len(loss_mb)
+    _np.testing.assert_allclose(loss_sp, loss_mb, rtol=1e-5)
+    # per-phase analytic costs are layout-INVARIANT (same math, new
+    # shapes) — the attribution lives in the model record, not the costs
+    assert _phase_cost_rounds(recs_sp) == _phase_cost_rounds(recs_mb)
+    # `colearn mfu` surfaces the layout line
+    report = mfu_report(recs_mb)
+    assert report["layout"]["cohort_layout"] == "megabatch"
+    assert report["layout"]["gemm_rows"] == 64
+    text = format_mfu_report(report)
+    assert "megabatch" in text and "gemm rows" in text
+    assert cli.main(["mfu", path_mb]) == 0
+
+
+def test_lora_phase_cost_model_counts_adapter_step(tmp_path):
+    """Under model.lora the analytic local_train step cost follows the
+    frozen-base structure — 4·P_full·B + 2·P_adapter·B — instead of
+    either the full-model 6·P_full·B or the adapter-only 6·P_adapter·B
+    (ROADMAP item 3 follow-up, ISSUE 12 satellite)."""
+    from colearn_federated_learning_tpu.obs.roofline import (
+        analytic_lora_step_flops,
+    )
+    from colearn_federated_learning_tpu.server.round_driver import (
+        Experiment,
+    )
+
+    cfg = get_named_config("bert_lora_federated")
+    cfg.apply_overrides({
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "model.kwargs.seq_len": 16,
+        "server.num_rounds": 2, "server.eval_every": 0,
+        "server.checkpoint_every": 0,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 32, "client.batch_size": 8,
+        "run.out_dir": str(tmp_path), "run.metrics_flush_every": 1,
+        "run.compute_dtype": "float32", "run.local_param_dtype": "",
+        "run.cohort_layout": "spatial",
+    })
+    cfg.validate()
+    Experiment(cfg, echo=False).fit()
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    m = next(r for r in recs if r.get("event") == "phase_cost_model")
+    assert m["flop_source"] == "analytic_lora"
+    assert m["n_coords_full"] > m["n_coords"]  # adapters ≪ full model
+    units = 8 * 16  # batch × seq_len (token corpora count tokens)
+    assert m["step_flops"] == analytic_lora_step_flops(
+        m["n_coords_full"], m["n_coords"], units
+    )

@@ -561,3 +561,270 @@ class TestBF16ComputeParity:
             ),
             sh["params"], sq["params"],
         )
+
+
+class TestCohortLayout:
+    """run.cohort_layout='megabatch' (r12, ROADMAP item 1): collapse
+    the cohort axis into the GEMM batch — a lane's whole client chunk
+    trains as ONE fused block (shared-weight first step at
+    [K_local·batch] GEMM rows, lane-local vmap after the per-client
+    params diverge) while every wire shape is untouched. The layout is
+    a pure performance knob, so the contract is PARITY — the documented
+    tolerance, per docs/DESIGN.md "Cohort layout & megabatching":
+    megabatch ≡ spatial at GEMM-reassociation tolerance (atol 1e-6 /
+    rtol 2e-5; measured ≤ 2 ulp on this backend). Bitwise is NOT
+    promised across layouts because changing the contraction shapes is
+    the layout's entire mechanism — XLA fuses each program differently
+    (the weighted-mean psum program happens to land bitwise here; the
+    krum/EF programs differ in the last ulp). Same-layout comparisons
+    (fused↔unfused via the driver, resume crossings) stay bitwise —
+    those run the same per-round programs."""
+
+    def _pair(self, cohort=8, lanes=2, fuse=1, **kw):
+        """(spatial_fn, megabatch_fn) engine twins plus shared inputs."""
+        model, params, x, y, idx, mask, n_ex = _setup(cohort=cohort)
+        ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1,
+                            momentum=0.9)
+        scfg = ServerConfig(optimizer="mean", server_lr=1.0,
+                            cohort_size=cohort)
+        init, server_update = make_server_update_fn(scfg)
+        mesh = build_client_mesh(lanes)
+        fns = {
+            layout: make_sharded_round_fn(
+                model, ccfg, DPConfig(), "classify", mesh, server_update,
+                cohort_size=cohort, donate=False, fuse_rounds=fuse,
+                cohort_layout=layout, **kw,
+            )
+            for layout in ("spatial", "megabatch")
+        }
+        args = (x, y, jnp.asarray(idx), jnp.asarray(mask),
+                jnp.asarray(n_ex))
+        return model, params, init(params), args, fns
+
+    @staticmethod
+    def _assert_bitwise(a, b):
+        jax.tree.map(
+            lambda p, q: np.testing.assert_array_equal(
+                np.asarray(p), np.asarray(q)
+            ),
+            a, b,
+        )
+
+    @staticmethod
+    def _assert_layout_parity(a, b):
+        # the documented cross-layout tolerance: the megabatch program
+        # contracts different GEMM shapes, so XLA's reassociation can
+        # move the last ulp (observed max 6e-8 on CPU)
+        jax.tree.map(
+            lambda p, q: np.testing.assert_allclose(
+                np.asarray(p), np.asarray(q), atol=1e-6, rtol=2e-5
+            ),
+            a, b,
+        )
+
+    @pytest.mark.parametrize("aggregator,attack", [
+        ("weighted_mean", ""),
+        ("weighted_mean", "sign_flip"),
+        ("krum", ""),
+        ("krum", "sign_flip"),
+    ])
+    def test_megabatch_matches_spatial(self, aggregator, attack):
+        kw = {"aggregator": aggregator}
+        if aggregator == "krum":
+            kw["byzantine_f"] = 1
+        if attack:
+            kw["attack"] = attack
+        _, params, opt_state, args, fns = self._pair(**kw)
+        rng = jax.random.PRNGKey(7)
+        extra = ()
+        if attack:
+            byz = np.zeros(8, np.float32)
+            byz[3] = 1.0
+            extra = (jnp.asarray(byz),)
+        p_sp, _, m_sp = fns["spatial"](params, opt_state, *args, rng, *extra)
+        p_mb, _, m_mb = fns["megabatch"](params, opt_state, *args, rng, *extra)
+        self._assert_layout_parity(p_sp, p_mb)
+        np.testing.assert_allclose(
+            float(m_sp.train_loss), float(m_mb.train_loss), rtol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_sp.examples), np.asarray(m_mb.examples)
+        )
+
+    def test_megabatch_fused_matches_spatial_fused(self):
+        """fuse_rounds=2 × megabatch: the fused scan body trains the
+        megabatched block per sub-round; parity against the fused
+        spatial twin (and, transitively via TestFusedRounds, against
+        the unfused loop) stays bitwise."""
+        _, params, opt_state, args, fns = self._pair(
+            fuse=2, aggregator="krum", byzantine_f=1, attack="sign_flip",
+        )
+        x, y, idx, mask, n_ex = args
+        f_idx = jnp.stack([idx, idx])
+        f_mask = jnp.stack([mask, mask])
+        f_nex = jnp.stack([n_ex, n_ex])
+        rngs = jnp.stack([jax.random.PRNGKey(11), jax.random.PRNGKey(12)])
+        byz = np.zeros((2, 8), np.float32)
+        byz[:, 3] = 1.0
+        f_byz = jnp.asarray(byz)
+        p_sp, _, m_sp = fns["spatial"](
+            params, opt_state, x, y, f_idx, f_mask, f_nex, rngs, f_byz
+        )
+        p_mb, _, m_mb = fns["megabatch"](
+            params, opt_state, x, y, f_idx, f_mask, f_nex, rngs, f_byz
+        )
+        self._assert_layout_parity(p_sp, p_mb)
+        assert m_mb.train_loss.shape == (2,)
+        np.testing.assert_allclose(
+            np.asarray(m_sp.train_loss), np.asarray(m_mb.train_loss),
+            rtol=1e-5,
+        )
+
+    @pytest.mark.parametrize("fuse", [1, 2])
+    def test_megabatch_error_feedback_matches_spatial(self, fuse):
+        """EF × megabatch: training is megabatched, the compression
+        memory (upload C(Δ+e), residual scatter-back) is untouched —
+        params AND the post-round residual store must match the spatial
+        twin bitwise, at fuse 1 and 2."""
+        _, params, opt_state, args, fns = self._pair(
+            fuse=fuse, compression="qsgd", error_feedback=True,
+            num_clients=16,
+        )
+        x, y, idx, mask, n_ex = args
+        store = jax.tree.map(
+            lambda p: jnp.zeros((16,) + p.shape, jnp.float32), params
+        )
+        cohort = jnp.arange(8, dtype=jnp.int32)
+        if fuse == 1:
+            ins = (x, y, idx, mask, n_ex, jax.random.PRNGKey(5), store,
+                   cohort)
+            p_sp, _, e_sp, _ = fns["spatial"](params, opt_state, *ins)
+            p_mb, _, e_mb, _ = fns["megabatch"](params, opt_state, *ins)
+        else:
+            rngs = jnp.stack(
+                [jax.random.PRNGKey(5), jax.random.PRNGKey(6)]
+            )
+            ins = (x, y, jnp.stack([idx, idx]), jnp.stack([mask, mask]),
+                   jnp.stack([n_ex, n_ex]), rngs, store,
+                   jnp.stack([cohort, cohort]))
+            p_sp, _, e_sp, _ = fns["spatial"](params, opt_state, *ins)
+            p_mb, _, e_mb, _ = fns["megabatch"](params, opt_state, *ins)
+        self._assert_layout_parity(p_sp, p_mb)
+        self._assert_layout_parity(e_sp, e_mb)
+
+    def test_megabatch_matches_sequential(self):
+        """The oracle crossing: the megabatched sharded engine against
+        the layout-free python-loop reference, at the engines'
+        established tolerance."""
+        model, params, opt_state, args, fns = self._pair()
+        ccfg = ClientConfig(local_epochs=2, batch_size=8, lr=0.1,
+                            momentum=0.9)
+        scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=8)
+        _, server_update = make_server_update_fn(scfg)
+        seq = make_sequential_round_fn(
+            model, ccfg, DPConfig(), "classify", server_update,
+            cohort_layout="megabatch",
+        )
+        rng = jax.random.PRNGKey(21)
+        p_mb, _, m_mb = fns["megabatch"](params, opt_state, *args, rng)
+        p_sq, _, m_sq = seq(params, opt_state, *args, rng)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+            ),
+            p_mb, p_sq,
+        )
+        np.testing.assert_allclose(
+            float(m_mb.train_loss), float(m_sq.train_loss), rtol=1e-5
+        )
+
+    def test_unaligned_resume_crossing(self, tmp_path):
+        """A megabatch run resumed at a NON-chunk-aligned round (the
+        fuse=1 catch-up twin is built with the same layout) must land
+        bitwise on the straight megabatch run — the layout composes
+        with the catch-up path, not just the steady-state loop."""
+        from colearn_federated_learning_tpu.config import get_named_config
+        from colearn_federated_learning_tpu.server.round_driver import (
+            Experiment,
+        )
+
+        def cfg_for(rounds, resume, fuse, out, ckpt):
+            cfg = get_named_config("mnist_fedavg_2")
+            cfg.data.num_clients = 8
+            cfg.server.cohort_size = 4
+            cfg.server.num_rounds = rounds
+            cfg.server.eval_every = 0
+            cfg.server.checkpoint_every = ckpt
+            cfg.run.out_dir = out
+            cfg.run.resume = resume
+            cfg.run.fuse_rounds = fuse
+            cfg.run.cohort_layout = "megabatch"
+            cfg.run.metrics_flush_every = 1
+            cfg.data.synthetic_train_size = 256
+            cfg.data.synthetic_test_size = 64
+            return cfg.validate()
+
+        Experiment(cfg_for(3, False, 1, str(tmp_path), 1), echo=False).fit()
+        exp = Experiment(cfg_for(6, True, 2, str(tmp_path), 2), echo=False)
+        resumed = exp.fit()
+        assert int(resumed["round"]) == 6
+        warns = [r for r in exp.logger.history
+                 if r.get("warning") == "fuse_unaligned_resume"]
+        assert len(warns) == 1
+        straight = Experiment(
+            cfg_for(6, False, 1, str(tmp_path / "straight"), 0), echo=False
+        ).fit()
+        self._assert_bitwise(straight["params"], resumed["params"])
+
+    def test_validation_and_engine_rejections(self):
+        from colearn_federated_learning_tpu.config import get_named_config
+        from colearn_federated_learning_tpu.parallel.round_engine import (
+            _check_engine_compat,
+        )
+
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.cohort_layout = "megablotch"
+        with pytest.raises(ValueError, match="cohort_layout"):
+            cfg.validate()
+        for algo in ("scaffold", "feddyn", "gossip", "fedbuff"):
+            cfg = get_named_config("mnist_fedavg_2")
+            cfg.run.cohort_layout = "megabatch"
+            cfg.algorithm = algo
+            if algo == "scaffold":
+                cfg.client.momentum = 0.0
+            with pytest.raises(ValueError, match="megabatch"):
+                cfg.validate()
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.cohort_layout = "megabatch"
+        cfg.run.batch_shards = 2
+        with pytest.raises(ValueError, match="batch_shards"):
+            cfg.validate()
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.run.cohort_layout = "megabatch"
+        cfg.run.client_vmap_width = 2
+        with pytest.raises(ValueError, match="client_vmap_width"):
+            cfg.validate()
+        # widths 0 and 1 both mean "the layout decides"
+        for w in (0, 1):
+            cfg = get_named_config("mnist_fedavg_2")
+            cfg.run.cohort_layout = "megabatch"
+            cfg.run.client_vmap_width = w
+            cfg.validate()
+        # the engine-level mirror guards direct factory callers
+        with pytest.raises(ValueError, match="cohort_layout"):
+            _check_engine_compat(False, "weighted_mean", "", 0.0,
+                                 cohort_layout="megablotch")
+        with pytest.raises(ValueError, match="stateful"):
+            _check_engine_compat(True, "weighted_mean", "", 0.0,
+                                 cohort_layout="megabatch")
+        # megabatch × batch-sharded mesh is rejected at construction
+        model = build_model("lenet5", num_classes=10)
+        ccfg = ClientConfig(local_epochs=1, batch_size=8, lr=0.1)
+        scfg = ServerConfig(optimizer="mean", server_lr=1.0, cohort_size=2)
+        _, server_update = make_server_update_fn(scfg)
+        mesh2 = build_client_mesh(2, batch_shards=2)
+        with pytest.raises(ValueError, match="batch-sharded"):
+            make_sharded_round_fn(
+                model, ccfg, DPConfig(), "classify", mesh2, server_update,
+                cohort_size=2, donate=False, cohort_layout="megabatch",
+            )
