@@ -241,6 +241,25 @@ def _gate(name: str, rounds_per_sec: float, device_ms, mfu_pct):
     return (rounds_per_sec / baseline if baseline else 1.0), "rounds_per_sec"
 
 
+_STATIC_CHECK_CACHE = None
+
+
+def _static_check_extra():
+    """Static-analyzer provenance for every bench entry's extra
+    (ISSUE 13): the analyzer version + whether `colearn check` passed
+    clean on the repo producing this number. Computed once per process
+    (the capability extraction runs ~600 validate() calls); best-effort
+    — a broken analyzer must never take the bench down."""
+    global _STATIC_CHECK_CACHE
+    if _STATIC_CHECK_CACHE is None:
+        from colearn_federated_learning_tpu.analysis.check import (
+            bench_provenance,
+        )
+
+        _STATIC_CHECK_CACHE = bench_provenance()
+    return _STATIC_CHECK_CACHE
+
+
 def _peak_host_rss_mb():
     """Peak resident set size of THIS process (ru_maxrss; KiB on
     Linux). Recorded in every result's extra so the BENCH trajectory
@@ -363,6 +382,7 @@ def bench_config(name: str):
         state, device_ms = _measure_device_ms(exp, state, warmup + timed)
     vs, vs_basis = _gate(name, rounds_per_sec, device_ms, flops_pct)
     extra = {
+        "static_check": _static_check_extra(),
         "vs_baseline_basis": vs_basis,
         "phase_ms": phase_ms,
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
@@ -579,6 +599,7 @@ def bench_weak_scale(name: str):
     ups_chip = timed * cohort / dt / exp.n_chips
     basis, peak_flops = _mfu_basis(cfg)
     extra = {
+        "static_check": _static_check_extra(),
         "weak_scale_per_chip_cohort": per_chip,
         "cohort_size": cohort,
         "n_chips": exp.n_chips,
@@ -704,6 +725,7 @@ def bench_store_scale(name: str):
             "unit": "rounds/sec",
             "vs_baseline": 1.0,
             "extra": {
+                "static_check": _static_check_extra(),
                 "num_clients": n,
                 "peak_host_rss_mb": rss,
                 "store_backed": True,
@@ -814,6 +836,7 @@ def bench_lora_scale(name: str):
             "unit": "rounds/sec",
             "vs_baseline": 1.0,
             "extra": {
+                "static_check": _static_check_extra(),
                 "num_clients": n,
                 "peak_host_rss_mb": rss,
                 "store_backed": True,

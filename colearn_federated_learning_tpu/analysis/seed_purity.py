@@ -1,0 +1,287 @@
+"""Seed-purity AST lint (`colearn check` analyzer b).
+
+The repo's observability contract says obs records are engine-invariant
+because every analytic model is a pure function of config + shapes and
+every schedule is a pure function of ``(seed, round[, snapshot])``.
+That contract is only as strong as the absence of impure calls in the
+program-path and record-producing modules, so this lint walks them for:
+
+- ``wallclock``: wall-clock reads (``time.time``/``perf_counter``/
+  ``monotonic``/``datetime.now``...) — calls AND bare references (the
+  spans tracer takes its clock as a default argument). Genuine timing
+  sites (spans, pager ``sync_ms``, store gather ``ms``, the record
+  timestamp) are documented in the checked-in allowlist.
+- ``unseeded_rng``: module-level ``np.random.*`` draws (everything but
+  the explicitly-seeded ``default_rng``/``Generator``/``SeedSequence``
+  constructors), ``os.urandom``, and any import of the stdlib
+  ``random``/``secrets`` modules (their global state is process-seeded
+  — nothing in library code may draw from it).
+- ``bare_assert``: ``assert`` in library code — stripped under
+  ``python -O``, so invariants guarded by it silently vanish; use
+  typed exceptions with messages.
+
+Findings are keyed ``(rule, file, qualname, symbol)``; the allowlist
+(analysis/seed_purity_allowlist.json) suppresses a finding only when an
+entry matches that key AND carries a non-empty ``reason`` — and every
+allowlist entry must match at least one live finding (stale entries
+fail, so the allowlist can't rot into a blanket waiver).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# lint scope, relative to the package directory: the program-path and
+# record-producing modules (ISSUE 13) — a directory means every .py in it
+DEFAULT_SCOPE = (
+    "parallel",
+    "server",
+    "client",
+    "obs",
+    "data/store.py",
+    "utils/metrics.py",
+)
+
+ALLOWLIST_FILE = os.path.join(os.path.dirname(__file__),
+                              "seed_purity_allowlist.json")
+
+# wall-clock attribute tails: (module-ish, function) — matched against
+# the LAST TWO components of a dotted attribute chain so both
+# ``time.time`` and ``datetime.datetime.now`` hit
+_WALLCLOCK_TAILS = {
+    ("time", "time"),
+    ("time", "time_ns"),
+    ("time", "monotonic"),
+    ("time", "monotonic_ns"),
+    ("time", "perf_counter"),
+    ("time", "perf_counter_ns"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("date", "today"),
+}
+
+# np.random constructors that take an explicit seed — NOT flagged
+_SEEDED_NP_RANDOM = {
+    "default_rng", "Generator", "SeedSequence", "BitGenerator",
+    "Philox", "PCG64", "PCG64DXSM", "MT19937",
+}
+
+# stdlib modules whose import is itself the violation (global
+# process-seeded RNG state)
+_RNG_MODULES = {"random", "secrets"}
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """Flatten ``a.b.c`` into ``["a", "b", "c"]`` (empty when the base
+    is not a plain name — e.g. a call result)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel_file: str):
+        self.rel_file = rel_file
+        self.stack: List[str] = []
+        self.findings: List[Dict[str, Any]] = []
+
+    # ---- helpers ----
+
+    def _qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def _add(self, rule: str, node: ast.AST, symbol: str, detail: str):
+        self.findings.append({
+            "rule": rule,
+            "file": self.rel_file,
+            "line": node.lineno,
+            "qualname": self._qualname(),
+            "symbol": symbol,
+            "detail": detail,
+        })
+
+    # ---- scoping ----
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    # ---- rules ----
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root in _RNG_MODULES:
+                self._add(
+                    "unseeded_rng", node, f"import {alias.name}",
+                    f"stdlib {root!r} draws from process-global RNG "
+                    f"state; use np.random.default_rng(seed)",
+                )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        root = (node.module or "").split(".")[0]
+        if root in _RNG_MODULES:
+            self._add(
+                "unseeded_rng", node, f"from {node.module} import ...",
+                f"stdlib {root!r} draws from process-global RNG state; "
+                f"use np.random.default_rng(seed)",
+            )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        chain = _attr_chain(node)
+        if len(chain) >= 2:
+            tail = (chain[-2], chain[-1])
+            symbol = ".".join(chain)
+            if tail in _WALLCLOCK_TAILS:
+                self._add(
+                    "wallclock", node, symbol,
+                    "wall-clock read in a program-path/record-producing "
+                    "module; allowlist genuine timing sites with a reason",
+                )
+            elif tail == ("os", "urandom"):
+                self._add(
+                    "unseeded_rng", node, symbol,
+                    "os.urandom is unseeded by construction",
+                )
+            elif (len(chain) >= 3 and chain[-2] == "random"
+                    and chain[-3] in ("np", "numpy")
+                    and chain[-1] not in _SEEDED_NP_RANDOM):
+                self._add(
+                    "unseeded_rng", node, symbol,
+                    "module-level np.random.* draws from the global "
+                    "NumPy RNG; use np.random.default_rng(seed)",
+                )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._add(
+            "bare_assert", node, "assert",
+            "bare assert is stripped under python -O; raise a typed "
+            "exception with a message",
+        )
+        self.generic_visit(node)
+
+
+def _scope_files(pkg_dir: str, scope: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for entry in scope:
+        path = os.path.join(pkg_dir, entry)
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                if name.endswith(".py"):
+                    files.append(os.path.join(path, name))
+        elif os.path.isfile(path):
+            files.append(path)
+    return files
+
+
+def lint_files(files: Sequence[str], rel_to: str) -> List[Dict[str, Any]]:
+    """Run the lint over explicit file paths; ``rel_to`` anchors the
+    ``file`` key of each finding (repo root for the real run, a tmp dir
+    in the fixture tests)."""
+    findings: List[Dict[str, Any]] = []
+    for path in files:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        linter = _Linter(os.path.relpath(path, rel_to))
+        linter.visit(tree)
+        findings.extend(linter.findings)
+    return findings
+
+
+def load_allowlist(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    with open(path or ALLOWLIST_FILE) as f:
+        return json.load(f)
+
+
+def _entry_matches(entry: Dict[str, Any], finding: Dict[str, Any]) -> bool:
+    if entry.get("rule") != finding["rule"]:
+        return False
+    if entry.get("file") != finding["file"]:
+        return False
+    if entry.get("qualname") != finding["qualname"]:
+        return False
+    if "symbol" in entry and entry["symbol"] != finding["symbol"]:
+        return False
+    return True
+
+
+def apply_allowlist(
+    findings: List[Dict[str, Any]], allowlist: List[Dict[str, Any]],
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], int]:
+    """Split findings into (violations, allowlist_problems, suppressed).
+
+    ``allowlist_problems`` carries entries with no reason and entries
+    matching no live finding (stale) — both are violations too: the
+    allowlist documents timing sites, it never silently waives them.
+    """
+    problems: List[Dict[str, Any]] = []
+    used = [False] * len(allowlist)
+    kept: List[Dict[str, Any]] = []
+    suppressed = 0
+    for entry in allowlist:
+        if not str(entry.get("reason", "")).strip():
+            problems.append({
+                "kind": "allowlist_missing_reason",
+                "entry": entry,
+            })
+    for finding in findings:
+        hit = False
+        for i, entry in enumerate(allowlist):
+            if _entry_matches(entry, finding):
+                used[i] = True
+                hit = True
+        if hit and str_reason_ok(allowlist, finding):
+            suppressed += 1
+        else:
+            kept.append(finding)
+    for i, entry in enumerate(allowlist):
+        if not used[i]:
+            problems.append({"kind": "allowlist_stale_entry", "entry": entry})
+    return kept, problems, suppressed
+
+
+def str_reason_ok(allowlist: List[Dict[str, Any]],
+                  finding: Dict[str, Any]) -> bool:
+    """A finding is only suppressed by an entry that has a reason —
+    a reason-less entry is itself flagged and suppresses nothing."""
+    return any(
+        _entry_matches(e, finding) and str(e.get("reason", "")).strip()
+        for e in allowlist
+    )
+
+
+def lint_repo(root: str, allowlist_path: Optional[str] = None,
+              scope: Sequence[str] = DEFAULT_SCOPE) -> Dict[str, Any]:
+    """The `colearn check` entry: lint the package's scope modules under
+    ``root`` and apply the shipped allowlist."""
+    pkg_dir = os.path.join(root, "colearn_federated_learning_tpu")
+    files = _scope_files(pkg_dir, scope)
+    findings = lint_files(files, root)
+    allowlist = load_allowlist(allowlist_path)
+    violations, problems, suppressed = apply_allowlist(findings, allowlist)
+    return {
+        "files_scanned": len(files),
+        "findings": len(findings),
+        "suppressed": suppressed,
+        "violations": violations,
+        "allowlist_problems": problems,
+    }

@@ -18,6 +18,10 @@ Entry points with capability parity to the reference's
                                # (obs/roofline.py phase-cost records)
     colearn bench-report       # BENCH_r*.json trajectory + per-phase
                                # budget gates (exit 1 on regression)
+    colearn check              # static invariant analyzer: capability
+                               # matrix + mirror drift, seed-purity
+                               # lint, JSONL schema cross-check
+                               # (exit 1 naming each violation)
 
 ``--config`` accepts a registry name or a YAML path; ``--set a.b=v``
 overrides any field. ``fit --resume`` continues from the latest
@@ -250,6 +254,25 @@ def build_parser():
                     help="emit the report as one JSON object instead of "
                          "the table")
 
+    ck = sub.add_parser(
+        "check",
+        help="static invariant analyzer (analysis/): capability-matrix "
+             "extraction + validate()/engine-mirror drift detection, "
+             "seed-purity AST lint against the checked-in allowlist, "
+             "and the JSONL record-schema emit/consume cross-check — "
+             "exits 1 naming each violation (pure host, no backend "
+             "init)",
+    )
+    ck.add_argument("--root", default=None,
+                    help="repo root to analyze (default: the directory "
+                         "holding the installed package)")
+    ck.add_argument("--update-matrix", action="store_true",
+                    help="regenerate capability_matrix.json from the "
+                         "code before checking (review the diff!)")
+    ck.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object "
+                         "instead of the table")
+
     br = sub.add_parser(
         "bench-report",
         help="bench regression observatory: the BENCH_r*.json "
@@ -343,6 +366,24 @@ def main(argv=None):
             return 2
         print(json.dumps(store_mod.open_store(out).describe()))
         return 0
+
+    if args.cmd == "check":
+        # static analysis over the repo itself: validate() and the
+        # engine-compat mirror are called as plain functions — no
+        # backend init, no engine construction
+        from colearn_federated_learning_tpu.analysis import check as _check
+
+        try:
+            report = _check.run_check(args.root,
+                                      update_matrix=args.update_matrix)
+        except (ValueError, OSError) as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(_check.format_report(report))
+        return 0 if report["clean"] else 1
 
     if args.cmd == "bench-report":
         # pure-host trajectory analysis over the checked-in BENCH

@@ -154,7 +154,11 @@ def blockwise_attention(q, k, v, heads: int, block_size: int, causal: bool = Tru
     kh = _split_heads(k, heads)
     vh = _split_heads(v, heads)
     b, h, t, hd = qh.shape
-    assert t % block_size == 0, (t, block_size)
+    if t % block_size:
+        raise ValueError(
+            f"blockwise_attention requires the sequence length to be a "
+            f"block_size multiple, got t={t}, block_size={block_size}"
+        )
     n_blocks = t // block_size
     scale = hd**-0.5
     # f32 recurrence math — same backend NaN workaround as ring_attention.

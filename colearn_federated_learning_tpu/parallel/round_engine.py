@@ -169,14 +169,49 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          downlink="", secagg_quant_step=0.0,
                          error_feedback=False, attack="",
                          client_ledger=False, reputation=False,
-                         fused_apply=False, cohort_layout="spatial"):
+                         fused_apply=False, cohort_layout="spatial",
+                         example_dp=False):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
     would have refused (e.g. a scaffold+median engine whose c_global
     update silently stays a plain poisonable mean). FedDyn's
-    algorithm-specific guard lives in ``_feddyn_prepare``."""
+    prox_mu injection (and a belt-and-braces copy of its pairing
+    guard) lives in ``_feddyn_prepare``.
+
+    ``example_dp`` is ``dp_cfg.enabled`` as the factories see it — the
+    ``colearn check`` capability extractor (analysis/capability.py)
+    surfaced that the mirror accepted scaffold/feddyn/attack engines
+    built directly with example-level DP while ``validate()`` rejects
+    all three pairings; the flag closes that drift."""
     robust = aggregator != "weighted_mean"
+    if feddyn and (robust or compression or clip_delta_norm > 0.0):
+        # params would move by the modified deltas while gᵢ/h track the
+        # raw trajectory. Historically guarded only in _feddyn_prepare;
+        # lifted into the shared mirror so the capability extractor's
+        # validate()↔mirror comparison sees one contract surface
+        # (_feddyn_prepare keeps its own guard for direct callers).
+        raise ValueError(
+            "feddyn is incompatible with robust aggregators, "
+            "compression, or delta clipping (the g/h recursion tracks "
+            "raw deltas)"
+        )
+    if example_dp and (scaffold or feddyn):
+        # mirror config.validate(): DP-SGD noise in the local steps
+        # would leak into the persistent c/h state the control-variate
+        # identities assume is a pure function of the deltas
+        raise ValueError(
+            "example-level DP is incompatible with stateful algorithms "
+            "(DP noise would enter the persistent c/h state)"
+        )
+    if example_dp and attack:
+        # mirror config.validate(): the example-level accountant
+        # assumes every client runs the DP-SGD mechanism, which a
+        # Byzantine client does not — the reported epsilon would lie
+        raise ValueError(
+            "attack simulation is incompatible with example-level DP "
+            "(a Byzantine client does not run the DP-SGD mechanism)"
+        )
     if scaffold and (robust or compression or clip_delta_norm > 0.0):
         # the c update (c += Σδc/N) has no robust equivalent and the
         # modified deltas would desynchronize params from the c
@@ -821,7 +856,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
                          reputation=reputation, fused_apply=fused_apply,
-                         cohort_layout=cohort_layout)
+                         cohort_layout=cohort_layout,
+                         example_dp=bool(getattr(dp_cfg, "enabled", False)))
     if fused_apply and not hasattr(server_update, "fused_reduce"):
         # the stacked-path kernel entry lives on the fused server
         # update (make_server_update_fn with cfg.fused_apply) — a
@@ -1976,7 +2012,8 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
                          error_feedback=error_feedback, attack=attack,
                          client_ledger=client_ledger,
                          reputation=reputation, fused_apply=fused_apply,
-                         cohort_layout=cohort_layout)
+                         cohort_layout=cohort_layout,
+                         example_dp=bool(getattr(dp_cfg, "enabled", False)))
     if fused_apply and not hasattr(server_update, "fused_reduce"):
         raise ValueError(
             "fused_apply=True requires a server_update built by "

@@ -56,9 +56,11 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
         b = x.shape[0]
         mb = max(1, min(cfg.microbatch_size, b))
         n_micro = b // mb
-        assert n_micro * mb == b, (
-            f"batch {b} not divisible by microbatch {mb}"
-        )
+        if n_micro * mb != b:
+            raise ValueError(
+                f"DP microbatching requires the batch to divide evenly: "
+                f"batch {b} is not divisible by microbatch {mb}"
+            )
         xm = x.reshape((n_micro, mb) + x.shape[1:])
         ym = y.reshape((n_micro, mb) + y.shape[1:])
         mm = m.reshape(n_micro, mb)
@@ -167,9 +169,11 @@ def make_dp_grad_fn(loss_fn, cfg: DPConfig, batch_axis: str | None = None):
         b = x.shape[0]
         mb = max(1, min(cfg.microbatch_size, b))
         n_micro = b // mb
-        assert n_micro * mb == b, (
-            f"batch {b} not divisible by microbatch {mb}"
-        )
+        if n_micro * mb != b:
+            raise ValueError(
+                f"DP microbatching requires the batch to divide evenly: "
+                f"batch {b} is not divisible by microbatch {mb}"
+            )
         xm = x.reshape((n_micro, mb) + x.shape[1:])
         ym = y.reshape((n_micro, mb) + y.shape[1:])
 

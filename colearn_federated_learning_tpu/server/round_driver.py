@@ -1810,7 +1810,13 @@ class Experiment:
         so they are never gathered."""
         with self.tracer.span("round.stream_slab"):
             uniq, inv = np.unique(idx, return_inverse=True)
-            assert len(uniq) <= self._slab_rows, (len(uniq), self._slab_rows)
+            if len(uniq) > self._slab_rows:
+                raise RuntimeError(
+                    f"stream slab overflow: round gathered {len(uniq)} "
+                    f"unique example rows but the static slab holds "
+                    f"{self._slab_rows} — the construction-time sizing "
+                    f"(cohort x cap + 1) should have prevented this"
+                )
             slab_x = np.empty((self._slab_rows,) + self.fed.train_x.shape[1:],
                               self.fed.train_x.dtype)
             slab_y = np.empty((self._slab_rows,) + self.fed.train_y.shape[1:],
@@ -2217,7 +2223,14 @@ class Experiment:
                 with self.tracer.span("round.stream_slab"):
                     uniq, inv = np.unique(idx_stack, return_inverse=True)
                     rows = self._fused_slab_rows
-                    assert len(uniq) <= rows, (len(uniq), rows)
+                    if len(uniq) > rows:
+                        raise RuntimeError(
+                            f"fused union-slab overflow: chunk gathered "
+                            f"{len(uniq)} unique example rows but the "
+                            f"static slab holds {rows} — the "
+                            f"construction-time sizing (fuse x cohort x "
+                            f"cap + 1) should have prevented this"
+                        )
                     if self._population is not None:
                         # union-slab dedup under fuse: the whole chunk's
                         # grid slots vs the one slab actually gathered

@@ -242,4 +242,49 @@ def config_reference_markdown() -> str:
         "runs one).",
         "",
     ]
+    appendix = capability_matrix_appendix()
+    if appendix:
+        lines += [appendix]
+    return "\n".join(lines)
+
+
+def capability_matrix_appendix() -> str:
+    """Auto-generated pairing-matrix appendix, sourced from the
+    checked-in ``capability_matrix.json`` (`colearn check` extracts it
+    from validate() + the engine-compat mirror; analysis/capability.py).
+    Only the rejected pairings are tabled — the artifact carries the
+    full space. Empty string when the artifact is absent (fresh
+    checkouts before the first `colearn check --update-matrix`)."""
+    import json
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "capability_matrix.json")
+    if not os.path.isfile(path):
+        return ""
+    with open(path) as f:
+        matrix = json.load(f)
+    c = matrix["counts"]
+    lines = [
+        "## Appendix: capability pairing matrix",
+        "",
+        f"Sourced from `capability_matrix.json` (version "
+        f"{matrix['version']}; regenerate with `colearn check "
+        f"--update-matrix`): {c['features']} features x {c['pairs']} "
+        f"pairings — {c['supported']} supported, {c['rejected']} "
+        f"rejected with reasons, {c['drift']} validate()/engine-mirror "
+        f"drift. The rejected pairings:",
+        "",
+        "| pairing | reason |",
+        "|---|---|",
+    ]
+    for entry in matrix["pairs"]:
+        if entry["validate"] == "rejected":
+            reason = entry.get("reason", "").replace("|", "\\|")
+            reason = " ".join(reason.split())
+            if len(reason) > 140:
+                reason = reason[:137] + "..."
+            lines.append(f"| `{entry['pair']}` | {reason} |")
+    lines.append("")
     return "\n".join(lines)
