@@ -149,6 +149,12 @@ FEATURES: Dict[str, Feature] = {
                            "pallas fused server-apply kernel"),
     "stragglers": Feature({"server.straggler_rate": 0.5}, False,
                           "partial-work straggler simulation"),
+    "churn": Feature({"run.churn.enabled": True,
+                      "run.churn.dropout_hazard": 0.1,
+                      "run.churn.crash_rate": 0.1}, False,
+                     "seed-pure diurnal availability / dropout hazard / "
+                     "crash-mid-round model (driver + sampler level; "
+                     "never reaches the engine)"),
     "batch_shards": Feature({"run.batch_shards": 2}, False,
                             "intra-client batch mesh axis"),
     "stream_placement": Feature({"data.placement": "stream"}, False,

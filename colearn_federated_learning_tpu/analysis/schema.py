@@ -72,6 +72,9 @@ REGISTRY: Dict[str, RecordSpec] = {
             "padded_step_fraction", "padded_example_fraction",
             "shape_bucket_steps", "dropped_clients", "straggler_clients",
             "dp_epsilon", "dp_client_epsilon", "mean_staleness",
+            "max_staleness", "staleness_clamped", "backpressure_dropped",
+            "backpressure_rejected", "churn_unavailable", "churn_dropped",
+            "churn_crashed",
             "byzantine_count", "consensus_dist", "rounds_per_sec",
             "client_updates_per_sec_per_chip", "eval_loss", "eval_acc",
         ),
@@ -102,12 +105,23 @@ REGISTRY: Dict[str, RecordSpec] = {
             "population_unique_clients", "population_coverage_pct",
             "population_participations", "pager_hit_rate",
             "store_gather_bytes",
+            # production-traffic totals (run.churn / fedbuff promotion)
+            "staleness_clamped", "backpressure_dropped",
+            "backpressure_rejected", "churn_unavailable", "churn_dropped",
+            "churn_crashed", "async_updates_absorbed",
+            "async_updates_per_sec", "async_staleness_bound",
         ),
         doc="end-of-fit totals (every exit path, aborts included)",
     ),
     "trace": RecordSpec(
         required=("path",), optional=("merged_fragments",),
         doc="Chrome-trace export provenance",
+    ),
+    "churn": RecordSpec(
+        required=("diurnal_period", "diurnal_amplitude",
+                  "base_availability", "min_availability",
+                  "dropout_hazard", "crash_rate"),
+        doc="churn hazard-model provenance at fit start (run.churn)",
     ),
     "resumed": RecordSpec(
         required=("round", "host_pipeline"),
@@ -170,7 +184,7 @@ REGISTRY: Dict[str, RecordSpec] = {
     "population_health": RecordSpec(
         required=("round", "window_rounds", "participants", "coverage",
                   "fairness", "staleness"),
-        optional=("draws", "sketch", "pager", "store"),
+        optional=("draws", "sketch", "pager", "store", "async", "churn"),
         doc="per-window federation health record (obs/population.py)",
     ),
 }

@@ -205,8 +205,11 @@ def build_parser():
         help="live view of a run from its metrics JSONL (pure host — "
              "no backend init, works mid-fit and on completed runs): "
              "rounds/sec, loss, health/divergence state, pager hit "
-             "rate, coverage %%, phase-ms sparklines; refreshes until "
-             "the run completes",
+             "rate, coverage %%, phase-ms sparklines, and — for "
+             "fedbuff/churn runs — the async panel (arrival rate, "
+             "staleness distribution + sparkline, clamp/backpressure "
+             "counts) and realized churn counts; refreshes until the "
+             "run completes",
     )
     wa.add_argument("run", metavar="RUN",
                     help="run name (looked up under --out-dir), a run "

@@ -321,6 +321,22 @@ class ServerConfig:
     async_max_staleness: int = 4
     # staleness decay exponent α: aggregation weight × (1+s)^-α
     async_staleness_exponent: float = 0.5
+    # fedbuff overload backpressure: cap on the COMPLETED-but-unpopped
+    # backlog beyond the K updates each server step absorbs. Under
+    # churn, offline clients defer completions and the backlog can
+    # spike when a diurnal wave brings a cohort back online; entries
+    # beyond the cap are shed per async_overload_policy, re-queued as
+    # fresh arrivals at the current version (their in-flight work is
+    # discarded — counted in round records and run_summary). 0 = no
+    # cap (every completion waits its turn, staleness absorbs the
+    # backlog instead).
+    async_backlog_cap: int = 0
+    # which completions are shed at the cap:
+    #   drop_oldest  — shed the STALEST waiting completions (bound the
+    #                  staleness tail; the freshest work survives)
+    #   reject_newest — shed the most recent completions (FIFO
+    #                  admission; the oldest waiters keep their slot)
+    async_overload_policy: str = "drop_oldest"  # drop_oldest | reject_newest
     # algorithm=feddyn only: the dynamic-regularization coefficient α
     # (both the client proximal pull and the server h-correction scale)
     feddyn_alpha: float = 0.1
@@ -581,9 +597,13 @@ class ClientLedgerConfig:
     Rejected pairings (validate(), with reasons): secure_aggregation
     (per-client uploads are exactly what masking hides), client-level
     DP (a per-client statistics channel voids the client-DP release),
-    gossip/fedbuff (no synchronous cohort upload stack), and
-    scaffold/feddyn (their store plumbing owns the per-client state
-    path; robust/attack forensics is rejected there anyway)."""
+    gossip (no server-visible upload stack), and scaffold/feddyn
+    (their store plumbing owns the per-client state path; robust/
+    attack forensics is rejected there anyway). ``algorithm="fedbuff"``
+    is SUPPORTED since the churn PR via per-INSERT stats — each async
+    server step computes the stats block over its popped buffer's
+    uploads and scatters by true client id (dense ledger only; the
+    paged hot set's slot remap stays a synchronous-dispatch feature)."""
 
     enabled: bool = False
     # EMA coefficient for the per-stat running means: ema_x moves by
@@ -753,6 +773,60 @@ class ShapeBucketsConfig:
 
 
 @dataclass
+class ChurnConfig:
+    """Seed-pure availability/churn model (``run.churn``,
+    server/churn.py — the production-traffic plane): per-client diurnal
+    availability waves, mid-round dropout hazard, and crash-mid-round
+    injection, every draw a pure function of ``(run.seed, round,
+    client_id)`` via counter-mode hashing — so schedules are
+    resume-replayable with zero checkpoint state and engine-invariant
+    (sharded ≡ sequential ≡ prefetch worker, bitwise).
+
+    Where it acts: the uniform and streaming cohort samplers reject
+    offline candidates (an unavailable client is simply not drawn);
+    any cohort member that still dispatches while offline, draws the
+    dropout hazard, or crashes mid-round realizes its failure through
+    the existing straggler/dropout machinery (``n_ex`` zeroing and
+    mask/spec truncation — partial work still aggregates, weighted by
+    the steps actually done). Under ``algorithm="fedbuff"`` offline
+    clients additionally DEFER their completions, growing realized
+    staleness — the regime the bounded-staleness admission gate
+    (``run.strict_staleness``) and the overload backpressure policy
+    (``server.async_backlog_cap``) exist for.
+
+    Rejected pairings (validate(), with reasons): gossip (all clients
+    train every round — there is no availability-gated cohort draw),
+    ``run.shape_buckets`` (crash truncation is parameterized on the
+    full-shape step grid, same reason as stragglers), and the
+    weighted/poisson/adaptive samplers (static size-weights and the
+    Poisson DP-exact ``q`` assume unconditional draws; the dense
+    adaptive scores would need availability renormalization — the
+    uniform and streaming samplers are the gated pair). ``enabled=
+    False`` constructs no model anywhere and is bitwise-identical to
+    pre-churn builds (test-pinned with stray knob values)."""
+
+    enabled: bool = False
+    # rounds per simulated day: each client's availability follows
+    # base + amplitude*sin(2π(round/period + phase_i)) with a fixed
+    # hash-derived per-client phase (its "timezone")
+    diurnal_period: int = 24
+    # peak-to-mean swing of the diurnal wave (0 = flat availability)
+    diurnal_amplitude: float = 0.5
+    # mean availability probability (the wave's midline)
+    base_availability: float = 0.75
+    # clip floor for the per-round availability probability: no client
+    # is ever permanently unreachable (the exploration-floor principle)
+    min_availability: float = 0.05
+    # probability a dispatched participant fails mid-round entirely
+    # (total failure — weight zeroed, same path as server.dropout_rate)
+    dropout_hazard: float = 0.0
+    # probability a dispatched participant crashes mid-round at a
+    # hash-drawn fraction of its local steps (partial work aggregates,
+    # mask-truncated — the straggler path)
+    crash_rate: float = 0.0
+
+
+@dataclass
 class RunConfig:
     seed: int = 0
     # sharded: the shard_map/psum round engine (one XLA program per round)
@@ -892,6 +966,19 @@ class RunConfig:
     shape_buckets: ShapeBucketsConfig = field(
         default_factory=ShapeBucketsConfig
     )
+    # Seed-pure availability/churn model — see ChurnConfig.
+    churn: ChurnConfig = field(default_factory=ChurnConfig)
+    # algorithm=fedbuff only: what a staleness-bound violation does.
+    # False (default) = the GRACEFUL path: an update whose start
+    # version aged out of the 2S+1 history ring trains against the
+    # OLDEST RETAINED version instead, its aggregation weight decays at
+    # the TRUE staleness (strictly stronger down-weighting), and the
+    # event is counted (`staleness_clamped` in round records and
+    # run_summary) with a warn-once log — the production behavior
+    # under churn, where offline clients legitimately exceed the
+    # bound. True = the pre-churn contract: any staleness > 2S raises
+    # (ring sizing is then an invariant, not a budget).
+    strict_staleness: bool = False
     # Observability block (spans / counters / health) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
 
@@ -1046,17 +1133,32 @@ class ExperimentConfig:
                 raise ValueError("fedbuff is incompatible with server.compression")
             if self.run.batch_shards > 1:
                 raise ValueError("fedbuff is incompatible with run.batch_shards")
-            if self.server.sampling != "uniform":
+            if self.server.sampling not in ("uniform", "streaming"):
+                # arrivals are drawn per server step: uniform draws, or
+                # the O(cohort·log) streaming sketch draw (optionally
+                # scored from the per-insert ledger stats — the
+                # million-client arrival path). weighted/poisson/
+                # adaptive parameterize a synchronous cohort draw the
+                # queue scheduler does not make.
                 raise ValueError(
-                    "fedbuff schedules clients via its own in-flight queue; "
+                    "fedbuff draws queue arrivals via uniform or "
+                    "streaming sampling only; "
                     f"server.sampling={self.server.sampling} is not supported"
                 )
-            if self.data.placement != "hbm":
-                raise ValueError("fedbuff requires data.placement=hbm")
             if self.server.async_max_staleness < 1:
                 raise ValueError("async_max_staleness must be >= 1")
             if self.server.async_staleness_exponent < 0.0:
                 raise ValueError("async_staleness_exponent must be >= 0")
+            if self.server.async_backlog_cap < 0:
+                raise ValueError("async_backlog_cap must be >= 0")
+            if self.server.async_overload_policy not in (
+                "drop_oldest", "reject_newest",
+            ):
+                raise ValueError(
+                    f"unknown server.async_overload_policy "
+                    f"{self.server.async_overload_policy!r}; expected "
+                    f"'drop_oldest' or 'reject_newest'"
+                )
         if self.algorithm == "scaffold":
             # the option-II control-variate identity cᵢ⁺ = (w₀−w_K)/(K·lr)
             # assumes plain SGD local steps (Karimireddy et al. 2020 §3);
@@ -1751,11 +1853,24 @@ class ExperimentConfig:
                     "client-level DP (per-client statistics are a "
                     "disclosure channel the DP analysis does not cover)"
                 )
-            if self.algorithm in ("gossip", "fedbuff"):
+            if self.algorithm == "gossip":
                 raise ValueError(
-                    f"run.obs.client_ledger is incompatible with "
-                    f"algorithm={self.algorithm!r} (no synchronous "
-                    f"cohort upload stack to compute stats over)"
+                    "run.obs.client_ledger is incompatible with "
+                    "algorithm='gossip' (no server-visible upload "
+                    "stack to compute stats over — neighbour messages "
+                    "are whole replicas)"
+                )
+            if self.algorithm == "fedbuff" and cl.hot_capacity > 0:
+                # per-INSERT stats over each server step's popped
+                # buffer feed the dense ledger fine (fedbuff × ledger
+                # is supported since the churn PR); the pager's
+                # id→hot-slot remap is wired into the synchronous
+                # dispatch paths only
+                raise ValueError(
+                    "run.obs.client_ledger.hot_capacity > 0 (paged "
+                    "ledger) is not supported with algorithm='fedbuff' "
+                    "— the async scheduler ships true client ids; use "
+                    "the dense ledger (hot_capacity=0)"
                 )
             if self.algorithm in ("scaffold", "feddyn"):
                 raise ValueError(
@@ -1790,7 +1905,7 @@ class ExperimentConfig:
                 "enabled (trust weights are computed from the "
                 "device-resident ledger rows; enabling the ledger also "
                 "applies its pairing exclusions — secagg, client-level "
-                "DP, gossip/fedbuff, stateful algorithms)"
+                "DP, gossip, stateful algorithms)"
             )
         if self.server.sampling in ("adaptive", "streaming"):
             ad = self.server.adaptive
@@ -1844,6 +1959,63 @@ class ExperimentConfig:
                     "'native' (the C++ pipeline prefetches future "
                     "cohorts ahead of sketch refreshes); use 'auto' or "
                     "'numpy'"
+                )
+        ch = self.run.churn
+        if ch.diurnal_period < 1:
+            raise ValueError(
+                f"run.churn.diurnal_period must be >= 1, "
+                f"got {ch.diurnal_period}"
+            )
+        if not 0.0 <= ch.diurnal_amplitude <= 1.0:
+            raise ValueError(
+                f"run.churn.diurnal_amplitude must be in [0, 1], "
+                f"got {ch.diurnal_amplitude}"
+            )
+        if not 0.0 < ch.base_availability <= 1.0:
+            raise ValueError(
+                f"run.churn.base_availability must be in (0, 1], "
+                f"got {ch.base_availability}"
+            )
+        if not 0.0 < ch.min_availability <= 1.0:
+            raise ValueError(
+                f"run.churn.min_availability must be in (0, 1], "
+                f"got {ch.min_availability}"
+            )
+        if not 0.0 <= ch.dropout_hazard < 1.0:
+            raise ValueError(
+                f"run.churn.dropout_hazard must be in [0, 1), "
+                f"got {ch.dropout_hazard}"
+            )
+        if not 0.0 <= ch.crash_rate < 1.0:
+            raise ValueError(
+                f"run.churn.crash_rate must be in [0, 1), "
+                f"got {ch.crash_rate}"
+            )
+        if ch.enabled:
+            if self.algorithm == "gossip":
+                raise ValueError(
+                    "run.churn is incompatible with algorithm='gossip' "
+                    "(every client trains every round — there is no "
+                    "availability-gated cohort draw; gossip's own "
+                    "dropout_rate models link failure)"
+                )
+            if self.run.shape_buckets.enabled:
+                # same reason as the straggler rejection: crash
+                # truncation cuts at a fraction of the FULL grid's
+                # steps; a trimmed grid would cut different examples
+                raise ValueError(
+                    "run.churn is incompatible with run.shape_buckets "
+                    "(crash-mid-round truncation is parameterized on "
+                    "the full-shape step grid, like stragglers)"
+                )
+            if self.server.sampling in ("weighted", "poisson", "adaptive"):
+                raise ValueError(
+                    f"run.churn gates the uniform and streaming cohort "
+                    f"samplers only; server.sampling="
+                    f"{self.server.sampling} is not supported (static "
+                    f"size weights and the Poisson DP-exact q assume "
+                    f"unconditional draws; dense adaptive scores would "
+                    f"need availability renormalization)"
                 )
         st = self.data.store
         if st.dir:
@@ -1946,6 +2118,7 @@ class ExperimentConfig:
             "run": RunConfig,
             "obs": ObsConfig,  # nested under run
             "shape_buckets": ShapeBucketsConfig,  # nested under run
+            "churn": ChurnConfig,  # nested under run
             "client_ledger": ClientLedgerConfig,  # nested under run.obs
             "population": PopulationConfig,  # nested under run.obs
             "reputation": ReputationConfig,  # nested under server

@@ -236,6 +236,20 @@ class PopulationTracker:
         self._w_slab_indexed = 0
         self._w_slab_unique = 0
         self._sketch_flag_cov: Optional[float] = None
+        # async (fedbuff) window accumulators: realized staleness
+        # distribution, admitted-update count, clamp + backpressure
+        # totals — fed by the scheduler, folded as the "async" section
+        self._w_async_stale: List[float] = []
+        self._w_async_max_stale = 0
+        self._w_async_steps = 0
+        self._w_async_absorbed = 0
+        self._w_async_clamped = 0
+        self._w_bp_dropped = 0
+        self._w_bp_rejected = 0
+        # churn window accumulators (run.churn realized failures) —
+        # fed at flush from the per-round failure stats
+        self._w_churn = {"unavailable": 0, "dropped": 0, "crashed": 0}
+        self._w_churn_seen = False
         # lifetime baselines for delta-ing the instrumented objects
         self._pager_base = {
             "hits": 0, "misses": 0, "page_ins": 0, "evictions": 0,
@@ -282,6 +296,37 @@ class PopulationTracker:
         of gather I/O the union slab saved."""
         self._w_slab_indexed += int(rows_indexed)
         self._w_slab_unique += int(rows_unique)
+
+    def observe_async(self, round_idx: int, staleness, *, absorbed: int,
+                      clamped: int = 0, bp_dropped: int = 0,
+                      bp_rejected: int = 0) -> None:
+        """One fedbuff server step's scheduler facts: the popped
+        buffer's realized staleness values, how many updates carried
+        weight (arrival-rate numerator), and the clamp/backpressure
+        counts. Pure observation on the fit thread (the async
+        scheduler is never double-buffered)."""
+        s = np.asarray(staleness, np.float64).reshape(-1)
+        self._w_async_steps += 1
+        self._w_async_absorbed += int(absorbed)
+        self._w_async_clamped += int(clamped)
+        self._w_bp_dropped += int(bp_dropped)
+        self._w_bp_rejected += int(bp_rejected)
+        if s.size:
+            self._w_async_stale.append(float(s.mean()))
+            self._w_async_max_stale = max(
+                self._w_async_max_stale, int(s.max())
+            )
+
+    def observe_churn(self, unavailable: int, dropped: int,
+                      crashed: int) -> None:
+        """One round's realized churn failures (run.churn): offline at
+        dispatch, hazard-dropped, crashed mid-round — counts only, fed
+        at metrics-flush from the per-round failure stats (fit
+        thread)."""
+        self._w_churn_seen = True
+        self._w_churn["unavailable"] += int(unavailable)
+        self._w_churn["dropped"] += int(dropped)
+        self._w_churn["crashed"] += int(crashed)
 
     def observe_sketch_refresh(self, total_flagged: float,
                                kept_flagged: float) -> None:
@@ -419,6 +464,31 @@ class PopulationTracker:
                     self._w_slab_unique / self._w_slab_indexed, 4
                 ),
             })
+        if self._w_async_steps:
+            # the fedbuff production-traffic panel: arrival rate
+            # (absorbed updates per server step), the realized
+            # staleness distribution, and clamp/backpressure counts
+            a: Dict[str, Any] = {
+                "server_steps": self._w_async_steps,
+                "updates_absorbed": self._w_async_absorbed,
+                "arrival_rate": round(
+                    self._w_async_absorbed / self._w_async_steps, 3
+                ),
+                "staleness_max": self._w_async_max_stale,
+            }
+            if self._w_async_stale:
+                s = np.asarray(self._w_async_stale, np.float64)
+                a["staleness_mean"] = round(float(s.mean()), 3)
+                a["staleness_p90"] = round(float(np.percentile(s, 90)), 3)
+            if self._w_async_clamped:
+                a["staleness_clamped"] = self._w_async_clamped
+            if self._w_bp_dropped:
+                a["backpressure_dropped"] = self._w_bp_dropped
+            if self._w_bp_rejected:
+                a["backpressure_rejected"] = self._w_bp_rejected
+            rec["async"] = a
+        if self._w_churn_seen:
+            rec["churn"] = {k: int(v) for k, v in self._w_churn.items()}
         # reset the window
         self._w_rounds = 0
         self._w_participants = 0
@@ -428,6 +498,15 @@ class PopulationTracker:
         self._w_unknown = 0
         self._w_slab_indexed = 0
         self._w_slab_unique = 0
+        self._w_async_stale = []
+        self._w_async_max_stale = 0
+        self._w_async_steps = 0
+        self._w_async_absorbed = 0
+        self._w_async_clamped = 0
+        self._w_bp_dropped = 0
+        self._w_bp_rejected = 0
+        self._w_churn = {"unavailable": 0, "dropped": 0, "crashed": 0}
+        self._w_churn_seen = False
         return rec
 
     def summary_totals(self, pager=None, store_arrays=()) -> Dict[str, Any]:
@@ -532,7 +611,9 @@ def watch_snapshot(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 snap["wall_time_sec"] = float(rec["wall_time_sec"])
             for k in ("population_coverage_pct", "population_unique_clients",
                       "pager_hit_rate", "ledger_evictions",
-                      "ledger_page_syncs"):
+                      "ledger_page_syncs", "async_updates_per_sec",
+                      "async_updates_absorbed", "staleness_clamped",
+                      "backpressure_dropped", "backpressure_rejected"):
                 if k in rec:
                     snap[k] = rec[k]
             continue
@@ -568,6 +649,11 @@ def watch_snapshot(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             if "rounds_per_sec" in rec:
                 snap["rps_series"].append(float(rec["rounds_per_sec"]))
                 snap["rounds_per_sec"] = float(rec["rounds_per_sec"])
+            if "mean_staleness" in rec:
+                # the fedbuff staleness-distribution panel's series
+                snap.setdefault("staleness_series", []).append(
+                    float(rec["mean_staleness"])
+                )
             for k in ("eval_loss", "eval_acc"):
                 if k in rec:
                     snap.setdefault("eval", {})[k] = float(rec[k])
@@ -586,9 +672,19 @@ def watch_snapshot(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         sketch = last_pop.get("sketch")
         if sketch:
             snap["sketch"] = sketch
+        asy = last_pop.get("async")
+        if asy:
+            # arrival-rate / staleness-distribution / backpressure
+            # panel (fedbuff under production traffic)
+            snap["async"] = asy
+        chn = last_pop.get("churn")
+        if chn:
+            snap["churn"] = chn
     # keep the series bounded for --json consumers and the sparklines
     snap["loss_series"] = snap["loss_series"][-64:]
     snap["rps_series"] = snap["rps_series"][-64:]
+    if "staleness_series" in snap:
+        snap["staleness_series"] = snap["staleness_series"][-64:]
     # top phases by cumulative time, round-loop family first
     top = sorted(phase_totals, key=lambda n: -phase_totals[n])[:5]
     snap["phase_ms"] = {
@@ -631,6 +727,47 @@ def format_watch(snap: Dict[str, Any], path: str = "") -> str:
             if health else "ok"
         )
     )
+    asy = snap.get("async")
+    if asy or snap.get("staleness_series"):
+        # production-traffic panel: arrival rate, staleness
+        # distribution (+ sparkline of the per-round means), clamp and
+        # backpressure counters — the fedbuff ops view under churn
+        parts = []
+        if asy and "arrival_rate" in asy:
+            parts.append(f"arrivals {asy['arrival_rate']:.1f} upd/step")
+        if asy and "staleness_mean" in asy:
+            line = f"staleness {asy['staleness_mean']:.2f}"
+            if "staleness_p90" in asy:
+                line += f"/p90 {asy['staleness_p90']:.2f}"
+            if "staleness_max" in asy:
+                line += f"/max {asy['staleness_max']}"
+            parts.append(line)
+        clamped = (asy or {}).get(
+            "staleness_clamped", snap.get("staleness_clamped")
+        )
+        if clamped:
+            parts.append(f"clamped {clamped}")
+        bp = ((asy or {}).get("backpressure_dropped", 0)
+              + (asy or {}).get("backpressure_rejected", 0)) or (
+            (snap.get("backpressure_dropped") or 0)
+            + (snap.get("backpressure_rejected") or 0)
+        )
+        if bp:
+            parts.append(f"backpressure {bp}")
+        if "async_updates_per_sec" in snap:
+            parts.append(f"{snap['async_updates_per_sec']:.1f} upd/s")
+        line = "async: " + ("  ".join(parts) if parts else "ok")
+        series = snap.get("staleness_series")
+        if series:
+            line += "  " + sparkline(series)
+        lines.append(line)
+    chn = snap.get("churn")
+    if chn:
+        lines.append(
+            "churn: " + "  ".join(
+                f"{k} {v}" for k, v in sorted(chn.items()) if v
+            )
+        )
     bits = []
     if "coverage_pct" in snap:
         bits.append(f"coverage {snap['coverage_pct']:.1f}%")
@@ -718,9 +855,32 @@ def population_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     rounds = participants = 0
     cov_series: List[float] = []
     saw_pager = saw_store = False
+    asy = {"server_steps": 0, "updates_absorbed": 0, "staleness_max": 0,
+           "staleness_clamped": 0, "backpressure_dropped": 0,
+           "backpressure_rejected": 0}
+    stale_means: List[float] = []
+    churn = {"unavailable": 0, "dropped": 0, "crashed": 0}
+    saw_async = saw_churn = False
     for r in recs:
         rounds += int(r.get("window_rounds", 0))
         participants += int(r.get("participants", 0))
+        a = r.get("async")
+        if a:
+            saw_async = True
+            for k in ("server_steps", "updates_absorbed",
+                      "staleness_clamped", "backpressure_dropped",
+                      "backpressure_rejected"):
+                asy[k] += int(a.get(k, 0))
+            asy["staleness_max"] = max(
+                asy["staleness_max"], int(a.get("staleness_max", 0))
+            )
+            if "staleness_mean" in a:
+                stale_means.append(float(a["staleness_mean"]))
+        c = r.get("churn")
+        if c:
+            saw_churn = True
+            for k in churn:
+                churn[k] += int(c.get(k, 0))
         for k, v in (r.get("draws") or {}).items():
             draws[k] = draws.get(k, 0) + int(v)
         cov = r.get("coverage") or {}
@@ -752,6 +912,18 @@ def population_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     if draws:
         report["draws"] = dict(sorted(draws.items()))
+    if saw_async:
+        if asy["server_steps"]:
+            asy["arrival_rate"] = round(
+                asy["updates_absorbed"] / asy["server_steps"], 3
+            )
+        if stale_means:
+            asy["staleness_mean"] = round(
+                float(np.mean(stale_means)), 3
+            )
+        report["async"] = asy
+    if saw_churn:
+        report["churn"] = churn
     if "sketch" in last:
         report["sketch"] = last["sketch"]
     if saw_pager:
@@ -818,6 +990,36 @@ def format_population_report(report: Dict[str, Any], path: str = "") -> str:
             f"staleness (rounds since last participation): mean "
             f"{st.get('mean', 0.0):.1f}  p50 {st.get('p50', 0.0):.0f}  max "
             f"{st.get('max', 0)}  (+{st.get('first_seen', 0)} first-time)"
+        )
+    asy = report.get("async")
+    if asy:
+        line = (
+            f"async traffic: {asy.get('updates_absorbed', 0)} updates "
+            f"over {asy.get('server_steps', 0)} server steps"
+        )
+        if "arrival_rate" in asy:
+            line += f" ({asy['arrival_rate']:.1f} upd/step)"
+        if "staleness_mean" in asy:
+            line += (
+                f"  staleness mean {asy['staleness_mean']:.2f} "
+                f"max {asy.get('staleness_max', 0)}"
+            )
+        bits = []
+        if asy.get("staleness_clamped"):
+            bits.append(f"clamped {asy['staleness_clamped']}")
+        if asy.get("backpressure_dropped"):
+            bits.append(f"bp-dropped {asy['backpressure_dropped']}")
+        if asy.get("backpressure_rejected"):
+            bits.append(f"bp-rejected {asy['backpressure_rejected']}")
+        if bits:
+            line += "  " + "  ".join(bits)
+        lines.append(line)
+    chn = report.get("churn")
+    if chn:
+        lines.append(
+            "churn: " + "  ".join(
+                f"{k} {v}" for k, v in sorted(chn.items())
+            )
         )
     pg = report.get("pager")
     if pg:

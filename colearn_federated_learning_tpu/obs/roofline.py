@@ -621,8 +621,53 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
             ),
             "cohort_layout": extra.get("cohort_layout"),
             "weak_scale": _tail_weak_scale_records(doc, parsed),
+            "async_throughput": _tail_async_records(doc, parsed),
         })
     return entries
+
+
+def _tail_async_records(doc, parsed) -> List[Dict[str, Any]]:
+    """``async_throughput_*`` bench records carried by one
+    BENCH_r*.json — the file's own parsed entry or extra ``--matrix``
+    tail lines, exactly like the weak-scale scan. Normalized to the
+    fields the async-throughput gate reads; anything unparsable or
+    missing them is skipped (the r01+ history predates async entries
+    and must keep loading clean)."""
+    candidates: List[Dict[str, Any]] = []
+    for line in str(doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and "async_throughput" in line):
+            continue
+        try:
+            candidates.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if (
+        str(parsed.get("config") or "").startswith("async_throughput")
+        or (parsed.get("extra") or {}).get("staleness_bound") is not None
+    ):
+        candidates.append(parsed)
+    records = []
+    seen = set()
+    for rec in candidates:
+        extra = rec.get("extra") or {}
+        ups = rec.get("value")
+        bound = extra.get("staleness_bound")
+        if ups is None or bound is None:
+            continue
+        name = str(rec.get("config") or rec.get("metric") or "async")
+        if name in seen:
+            continue
+        seen.add(name)
+        records.append({
+            "name": name,
+            "updates_per_sec": float(ups),
+            "staleness_bound": int(bound),
+            "max_realized_staleness": extra.get("max_realized_staleness"),
+            "staleness_clamped": extra.get("staleness_clamped"),
+            "backpressure_shed": extra.get("backpressure_shed"),
+        })
+    return records
 
 
 def _tail_weak_scale_records(doc, parsed) -> List[Dict[str, Any]]:
@@ -743,6 +788,20 @@ def bench_report(entries: Sequence[Dict[str, Any]],
                     f"phase {ph}: {ms:.2f} ms/round exceeds "
                     f"{budget:.2f} ms ({src})"
                 )
+    # async-throughput floor (the promoted FedBuff plane): gate the
+    # NEWEST history entry that carries an async_throughput record —
+    # histories that predate the entry never fire (n/a, not a gate)
+    ups_min = budgets.get("async_updates_per_sec_min")
+    if ups_min is not None:
+        with_async = [e for e in entries if e.get("async_throughput")]
+        if with_async:
+            for rec in with_async[-1]["async_throughput"]:
+                if rec["updates_per_sec"] < float(ups_min):
+                    violations.append(
+                        f"async updates/sec {rec['updates_per_sec']:.1f} "
+                        f"< budget floor {float(ups_min):.1f} "
+                        f"({rec['name']}, {with_async[-1]['file']})"
+                    )
     return {
         "entries": list(entries),
         "latest": latest,

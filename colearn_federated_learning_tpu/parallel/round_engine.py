@@ -1802,7 +1802,14 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
 def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         buffer_size: int, window: int, donate: bool = True,
                         client_vmap_width: int = 1, local_dtype=None,
-                        clip_delta_norm: float = 0.0, scan_unroll: int = 1):
+                        clip_delta_norm: float = 0.0, scan_unroll: int = 1,
+                        client_ledger: bool = False,
+                        ledger_ema: float = 0.2,
+                        ledger_zmax: float = 3.5,
+                        reputation: bool = False,
+                        rep_floor: float = 0.05,
+                        rep_strength: float = 6.0,
+                        rep_z_gain: float = 1.0):
     """Asynchronous buffered FL (FedBuff, Nguyen et al. 2022) — one
     server step as one XLA program.
 
@@ -1827,6 +1834,36 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     The batch axis / scaffold / robust / compression features of the
     sync engine are deliberately not composed here (config.validate
     rejects them with algorithm=fedbuff).
+
+    ``client_ledger`` (the churn PR — fedbuff promoted onto the
+    million-client plane): per-INSERT forensic stats. The lane emits
+    the popped buffer's per-client delta stack instead of accumulating
+    it in-scan (the sync engine's ``emit_stack`` memory shape — the
+    ring, not the stack, is fedbuff's marginal HBM cost), the round fn
+    gains trailing ``cohort`` [K] int32 + ``ledger`` inputs, computes
+    the SAME shared stats block (obs/ledger.py ``client_round_stats``
+    over the wire uploads vs the staleness-weighted aggregate) and
+    scatters it by true client id, returning the updated ledger before
+    the metrics::
+
+        (..., slots, cohort, ledger, cur_slot, next_slot, rng)
+        → (new_history, new_params, new_opt_state, new_ledger, metrics)
+
+    ``reputation`` (requires ``client_ledger``) gates the
+    staleness-aware reputation-weighted merge: the [K] trust weights
+    derive in-program from the ledger AS CARRIED IN (this step's stats
+    land after aggregation) and fold multiplicatively into the
+    host-computed staleness decay — the admitted weight is
+    ``base·(1+s)^-α·trust``, numerator and denominator. With both
+    flags off the program is bit-identical to the pre-churn engine.
+
+    One async-specific wrinkle the sync ledger never sees: the popped
+    buffer CAN contain the same client twice (independent in-flight
+    arrivals), and ``update_ledger``'s ``.at[].set`` scatter collapses
+    duplicate in-range rows to one insert (last-writer-wins). The
+    ledger's participation count therefore undercounts absorbed
+    updates by at most the within-step duplicate rate — bounded, and
+    irrelevant to aggregation (both duplicates' deltas still merge).
     """
     local_train = make_local_train_fn(
         model, client_cfg, dp_cfg, task, local_dtype=local_dtype,
@@ -1845,6 +1882,163 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             f"clients per lane"
         )
     use_decay = client_cfg.lr_decay != 1.0
+    if reputation and not client_ledger:
+        # mirror config.validate(): trust weights are a pure function
+        # of the ledger rows — without the ledger there is no evidence
+        raise ValueError(
+            "reputation weighting requires client_ledger (trust is "
+            "computed from the device-resident ledger rows)"
+        )
+
+    if client_ledger:
+        # Per-insert stats path: the lane emits the buffer's [K, ...]
+        # per-client delta stack (client-sharded) instead of the
+        # in-scan weighted accumulation — same memory shape as the
+        # sync engine's emit_stack modes; aggregation, stats, and the
+        # ledger scatter run as plain jnp under the round jit (GSPMD
+        # handles the client-sharded axis), mirroring the sync path.
+        def lane_stack_fn(history, train_x, train_y, idx, mask, slots,
+                          keys, *rest):
+            lr_scale = rest[0] if use_decay else None
+            history = _pcast_varying(history)
+
+            def train_one(slot, b_idx, b_mask, key):
+                start = jax.tree.map(
+                    lambda h: jnp.take(h, slot, axis=0), history
+                )
+                extra = () if lr_scale is None else (lr_scale,)
+                w, m = local_train(start, train_x, train_y, b_idx, b_mask,
+                                   key, *extra)
+                delta = jax.tree.map(
+                    lambda wi, p: (wi.astype(jnp.float32)
+                                   - p.astype(jnp.float32)),
+                    w, start,
+                )
+                return delta, m
+
+            def per_block(_, inp):
+                b_idx, b_mask, b_slot, b_keys = inp
+                delta_b, m_b = jax.vmap(
+                    train_one, in_axes=(0, 0, 0, 0),
+                )(b_slot, b_idx, b_mask, b_keys)
+                pre_b = delta_b  # ledger resid: raw Δ vs shipped Δ
+                if clip_delta_norm > 0.0:
+                    delta_b = _clip_block(delta_b, clip_delta_norm)
+                from colearn_federated_learning_tpu.obs.ledger import (
+                    upload_residual,
+                )
+
+                ys = {
+                    "delta": delta_b,
+                    "pc_loss": m_b.loss,
+                    "pc_resid": upload_residual(pre_b, delta_b),
+                }
+                return None, ys
+
+            n_blocks = idx.shape[0] // width
+            blocked = jax.tree.map(
+                lambda a: a.reshape((n_blocks, width) + a.shape[1:]),
+                (idx, mask, slots, keys),
+            )
+            _, ys = jax.lax.scan(per_block, None, blocked)
+            unblock = lambda t: jax.tree.map(  # noqa: E731
+                lambda a: a.reshape((idx.shape[0],) + a.shape[2:]), t
+            )
+            return {
+                "deltas": unblock(ys["delta"]),
+                "pc_loss": unblock(ys["pc_loss"]),
+                "pc_resid": unblock(ys["pc_resid"]),
+            }
+
+        stack_in_specs = (P(), P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                          P(CLIENT_AXIS), P(CLIENT_AXIS))
+        if use_decay:
+            stack_in_specs += (P(),)
+        sharded_stack_lane = jax.shard_map(
+            lane_stack_fn, mesh=mesh, in_specs=stack_in_specs,
+            out_specs={
+                "deltas": P(CLIENT_AXIS),
+                "pc_loss": P(CLIENT_AXIS),
+                "pc_resid": P(CLIENT_AXIS),
+            },
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 10) if donate else ())
+        def ledger_round_fn(history, server_opt_state, train_x, train_y,
+                            idx, mask, agg_w, n_ex, slots, cohort, ledger,
+                            cur_slot, next_slot, rng):
+            for leaf in jax.tree.leaves(history):
+                if leaf.shape[0] != window:
+                    raise ValueError(
+                        f"history ring has {leaf.shape[0]} slots, engine "
+                        f"was built for window={window}"
+                    )
+                break
+            keys = jax.random.split(rng, idx.shape[0])
+            extra = ()
+            if use_decay:
+                extra = (_decay_scale(client_cfg.lr_decay, server_opt_state),)
+            with jax.named_scope("fedbuff_train_stack"):
+                out = sharded_stack_lane(
+                    history, train_x, train_y, idx, mask, slots, keys,
+                    *extra,
+                )
+            wire = out["deltas"]
+            trust = None
+            w = agg_w.astype(jnp.float32)
+            if reputation:
+                # staleness-aware reputation-weighted merge: the trust
+                # from the CARRIED ledger folds into the host-computed
+                # staleness decay — admitted weight base·(1+s)^-α·trust
+                from colearn_federated_learning_tpu.server.aggregation import (  # noqa: E501
+                    reputation_weights,
+                )
+
+                trust = reputation_weights(
+                    ledger, cohort.astype(jnp.int32), rep_floor,
+                    rep_strength, rep_z_gain, ledger_zmax,
+                )
+                w = w * trust.astype(jnp.float32)
+            with jax.named_scope("fedbuff_aggregate"):
+                w_sum = w.sum()
+                # async weights are FRACTIONAL — guard only the true
+                # all-dropout case (same semantics as the psum path)
+                denom = jnp.where(w_sum > 0, w_sum, 1.0)
+                mean_delta = jax.tree.map(
+                    lambda d: jnp.einsum("c,c...->...", w, d) / denom,
+                    wire,
+                )
+                mean_loss = (w * out["pc_loss"]).sum() / denom
+                n_total = n_ex.sum()
+            with jax.named_scope("round_server_apply"):
+                current = jax.tree.map(
+                    lambda h: jnp.take(h, cur_slot, axis=0), history
+                )
+                new_params, new_opt_state = server_update(
+                    current, server_opt_state, mean_delta
+                )
+                new_history = jax.tree.map(
+                    lambda h, p: h.at[next_slot].set(p.astype(h.dtype)),
+                    history, new_params,
+                )
+            with jax.named_scope("round_client_ledger"):
+                from colearn_federated_learning_tpu.obs.ledger import (
+                    client_round_stats,
+                    update_ledger,
+                )
+
+                stats = client_round_stats(
+                    wire, mean_delta, out["pc_loss"], out["pc_resid"],
+                    n_ex, ledger_zmax,
+                )
+                new_ledger = update_ledger(
+                    ledger, cohort.astype(jnp.int32), n_ex, stats,
+                    ledger_ema,
+                )
+            return (new_history, new_params, new_opt_state, new_ledger,
+                    RoundMetrics(mean_loss, n_total))
+
+        return ledger_round_fn
 
     def lane_fn(history, train_x, train_y, idx, mask, agg_w, n_ex, slots,
                 keys, *rest):

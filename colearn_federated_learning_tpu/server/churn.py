@@ -1,0 +1,160 @@
+"""Seed-pure availability/churn model (``run.churn``, ROADMAP item 4).
+
+Production federations of the CoLearn class see diurnal availability
+waves, dropouts, and mid-round crashes (FedScale, Lai et al. 2022 —
+trace-shaped device behavior); the synchronous lab loop sees none of
+them. This module is the repo's churn source of truth: every realized
+churn event is a **pure function of (run.seed, round, client_id)** —
+no RNG state, no host clock — computed by counter-mode integer hashing
+(SplitMix64 over the packed key), so:
+
+- schedules are **resume-replayable**: a run restored from any
+  checkpoint re-derives the exact availability/dropout/crash draws the
+  straight run made (nothing churn-related rides the checkpoint);
+- draws are **engine-invariant**: the sharded engine, the sequential
+  oracle, and the prefetch worker thread all evaluate the same pure
+  function and agree bitwise;
+- evaluation is **O(len(ids))**: the streaming sampler can gate a
+  million-client universe without ever materializing an O(N) schedule.
+
+The model has three planes, all gated by ``ChurnConfig``:
+
+- **Diurnal availability**: each client carries a fixed phase
+  ``phase_i = hash01(seed, PHASE, 0, i)`` and is available in round
+  ``r`` with probability ``clip(base + amplitude·sin(2π(r/period +
+  phase_i)), min_availability, 1)`` — the classic day/night
+  participation wave with per-client timezone offsets. The realized
+  availability bit is an independent hash draw against that
+  probability.
+- **Dropout hazard**: a *sampled* (or fedbuff-popped) client fails
+  mid-round with probability ``dropout_hazard`` — total failure, its
+  aggregation weight zeroes through the same ``n_ex`` path as
+  ``server.dropout_rate``.
+- **Crash-mid-round injection**: with probability ``crash_rate`` a
+  participant crashes after a hash-drawn fraction of its local steps —
+  realized through the existing straggler/mask-spec truncation path
+  (the partial update still aggregates, weighted by the work done),
+  which is exactly what a device killed mid-training uploads under
+  FedBuff-style partial-work semantics.
+
+Where it hooks in: the cohort samplers reject unavailable candidates
+(server/sampler.py ``availability_fn``), the round driver's
+``_apply_failures`` realizes dropout/crash on the dispatched cohort,
+and the fedbuff scheduler defers offline completions (growing realized
+staleness — the regime the bounded-staleness admission gate exists
+for). ``enabled=False`` constructs no model anywhere: schedules and
+params are bitwise-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# domain-separation tags for the per-plane hash streams (arbitrary odd
+# constants; distinct so the planes are independent draws)
+_TAG_PHASE = np.uint64(0x9E3779B97F4A7C15)
+_TAG_AVAIL = np.uint64(0xC2B2AE3D27D4EB4F)
+_TAG_DROP = np.uint64(0x165667B19E3779F9)
+_TAG_CRASH = np.uint64(0x27D4EB2F165667C5)
+_TAG_FRAC = np.uint64(0x85EBCA6B2C2B2AE3)
+
+_TWO_PI = 2.0 * np.pi
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """SplitMix64 finalizer over uint64 (the same mixer the population
+    HLL uses) — a bijective avalanche, vectorized."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(30)))
+             * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(27)))
+             * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+        return (x ^ (x >> np.uint64(31))).astype(np.uint64)
+
+
+def _hash01(seed: int, tag: np.uint64, round_idx: int,
+            ids: np.ndarray) -> np.ndarray:
+    """Uniform [0, 1) draw per id, pure in (seed, tag, round, id):
+    three chained SplitMix64 rounds over the packed key — enough
+    avalanche that adjacent (round, id) pairs are independent to the
+    53-bit double precision the [0,1) map keeps."""
+    ids64 = np.asarray(ids, dtype=np.int64).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        h = _splitmix64(np.uint64(np.uint64(seed & 0xFFFFFFFFFFFFFFFF)) ^ tag)
+        h = _splitmix64(h + np.uint64(round_idx & 0xFFFFFFFFFFFFFFFF))
+        h = _splitmix64(h ^ _splitmix64(ids64))
+    # top 53 bits → [0, 1) exactly representable in float64
+    return (h >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+
+
+class ChurnModel:
+    """The seed-pure churn oracle (see module docstring). Stateless by
+    construction: every method is a pure function of its arguments and
+    the frozen config, so instances are free to construct anywhere
+    (driver, sampler closure, tests) and always agree."""
+
+    def __init__(self, cfg, seed: int):
+        # cfg is config.ChurnConfig (duck-typed so tests can pass a
+        # stand-in); values frozen here — mutation after construction
+        # must not silently change schedules mid-run
+        self.seed = int(seed)
+        self.period = int(cfg.diurnal_period)
+        self.amplitude = float(cfg.diurnal_amplitude)
+        self.base = float(cfg.base_availability)
+        self.floor = float(cfg.min_availability)
+        self.dropout_hazard = float(cfg.dropout_hazard)
+        self.crash_rate = float(cfg.crash_rate)
+
+    # ---- diurnal availability ---------------------------------------
+
+    def availability_prob(self, round_idx: int, ids) -> np.ndarray:
+        """[len(ids)] per-client availability probability for this
+        round: the diurnal wave at each client's fixed phase, clipped
+        to [min_availability, 1] so no client is ever permanently
+        unreachable (the exploration-floor principle)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        phase = _hash01(self.seed, _TAG_PHASE, 0, ids)
+        wave = np.sin(_TWO_PI * (round_idx / max(1, self.period) + phase))
+        return np.clip(self.base + self.amplitude * wave, self.floor, 1.0)
+
+    def available(self, round_idx: int, ids) -> np.ndarray:
+        """[len(ids)] bool: is each client online in this round?"""
+        ids = np.asarray(ids, dtype=np.int64)
+        u = _hash01(self.seed, _TAG_AVAIL, round_idx, ids)
+        return u < self.availability_prob(round_idx, ids)
+
+    # ---- in-round failures ------------------------------------------
+
+    def dropped(self, round_idx: int, ids) -> np.ndarray:
+        """[len(ids)] bool: mid-round total failure (dropout hazard)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if self.dropout_hazard <= 0.0:
+            return np.zeros(len(ids), dtype=bool)
+        return _hash01(self.seed, _TAG_DROP, round_idx, ids) < self.dropout_hazard
+
+    def crashed(self, round_idx: int, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """(crashed [bool], work_fraction [float64]) per client: a
+        crash kills the client after ``work_fraction`` of its local
+        steps — the fraction is itself a hash draw in (0, 1], so two
+        crashes in different rounds truncate at different points."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if self.crash_rate <= 0.0:
+            return (np.zeros(len(ids), dtype=bool),
+                    np.ones(len(ids), dtype=np.float64))
+        crashed = _hash01(self.seed, _TAG_CRASH, round_idx, ids) < self.crash_rate
+        # (0, 1]: a crash always completes at least the fraction the
+        # truncation floor maps to >= 1 step
+        frac = 1.0 - _hash01(self.seed, _TAG_FRAC, round_idx, ids)
+        return crashed, frac
+
+
+def build_churn_model(cfg) -> "ChurnModel | None":
+    """Driver entry: the model iff ``cfg.run.churn.enabled`` (None
+    otherwise — churn-off code paths must construct nothing, the
+    bitwise-identity contract)."""
+    if not cfg.run.churn.enabled:
+        return None
+    return ChurnModel(cfg.run.churn, cfg.run.seed)

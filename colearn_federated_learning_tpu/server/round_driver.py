@@ -183,6 +183,19 @@ class Experiment:
         self._sketch_stats = np.zeros(
             (cfg.server.adaptive.sketch_size, 3), np.float32
         )
+        # Seed-pure availability/churn model (run.churn, server/
+        # churn.py): every realized churn event is a pure function of
+        # (run.seed, round, client_id), so schedules stay resume-
+        # replayable and engine-invariant with zero checkpoint state.
+        # The samplers reject offline candidates; dispatched cohort
+        # members realize dropout/crash through _apply_failures; the
+        # fedbuff scheduler defers offline completions. churn-off
+        # constructs no model anywhere (bitwise-identity contract).
+        from colearn_federated_learning_tpu.server.churn import (
+            build_churn_model,
+        )
+
+        self._churn = build_churn_model(cfg)
         self.sampler = CohortSampler(
             self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
             weights=(
@@ -197,6 +210,9 @@ class Experiment:
             staleness_gain=cfg.server.adaptive.staleness_gain,
             flag_suppress=cfg.server.adaptive.flag_suppress,
             sketch_size=cfg.server.adaptive.sketch_size,
+            availability_fn=(
+                self._churn.available if self._churn is not None else None
+            ),
         )
         # Poisson sampling: the realized Binomial(N, q) cohort is padded
         # to a STATIC cap of K + 5σ (so XLA never retraces); overflow
@@ -288,7 +304,13 @@ class Experiment:
             self._duration_base = (
                 1 + (ranks * s) // max(len(work), 1)
             ).astype(np.int32)
-        self._async_stats: Dict[int, float] = {}
+        # per-round async scheduler stats (mean/max staleness, clamp +
+        # backpressure counts), drained into round records at flush;
+        # _traffic_totals accumulates the summable ones for run_summary
+        self._async_stats: Dict[int, Dict[str, Any]] = {}
+        self._traffic_totals: Dict[str, int] = {}
+        self._async_absorbed = 0
+        self._staleness_warned = False
         # observability (run.obs, obs/): per-round comm-byte and
         # failure-count stats keyed by round (host-side, popped at
         # flush); the tracer + health monitor are built after the
@@ -413,6 +435,13 @@ class Experiment:
                     local_dtype=self._local_dtype(),
                     clip_delta_norm=cfg.server.clip_delta_norm,
                     scan_unroll=cfg.run.scan_unroll,
+                    client_ledger=self._ledger_on,
+                    ledger_ema=lcfg.ema,
+                    ledger_zmax=lcfg.zmax,
+                    reputation=cfg.server.reputation.enabled,
+                    rep_floor=cfg.server.reputation.floor,
+                    rep_strength=cfg.server.reputation.strength,
+                    rep_z_gain=cfg.server.reputation.z_gain,
                 )
             else:
                 def _make_engine(fuse):
@@ -1466,7 +1495,8 @@ class Experiment:
         else:
             idx, mask, n_ex = make_round_indices(self.fed, cohort, shape, host_rng)
         mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng,
-                                          round_idx=round_idx, shape=shape)
+                                          round_idx=round_idx, shape=shape,
+                                          cohort=cohort)
         if self._poisson:
             cap, b = self._poisson_cap, len(cohort)
             if b > cap:
@@ -1499,7 +1529,7 @@ class Experiment:
         return cohort, idx, mask, n_ex, slab
 
     def _apply_failures(self, mask, n_ex, k, host_rng, round_idx=None,
-                        shape=None):
+                        shape=None, cohort=None):
         """Straggler truncation + dropout zeroing — shared by the sync
         cohort path and the async (fedbuff) scheduler. Realized counts
         are recorded per round for the telemetry counters (this runs on
@@ -1509,7 +1539,21 @@ class Experiment:
         spec's valid-steps column and recomputes the weights through the
         closed form ``spec_examples`` (exactly ``mask.sum((1, 2))`` of
         the expanded mask), so both representations realize identical
-        failures from identical host draws."""
+        failures from identical host draws.
+
+        With ``run.churn`` on, ``cohort`` (the round's client ids)
+        additionally realizes the seed-pure churn draws through the
+        SAME machinery: a crash-mid-round truncates the client's mask
+        at its hash-drawn work fraction (the straggler path — partial
+        work still aggregates), and offline/hazard-dropped members
+        zero their weight (the dropout path). Every churn draw is a
+        pure function of (seed, round, id) — no host_rng consumption —
+        so churn-on failures are identical across engines, resumes,
+        and the prefetch worker, and churn-off leaves host_rng's
+        stream untouched (the bitwise-identity contract). An all-
+        dropped round is legitimate (a diurnal trough): the engines'
+        degenerate-denominator path handles it, exactly like an empty
+        poisson round."""
         if k == 0:
             return mask, n_ex  # empty poisson round: nothing to fail
         shape = shape or self.shape
@@ -1552,12 +1596,55 @@ class Experiment:
                 mask = mask.copy()
                 mask[~participate] = 0.0
             n_drop = int(k - participate.sum())
+        n_unavail = n_hazard = n_crash = 0
+        if (self._churn is not None and cohort is not None
+                and round_idx is not None):
+            ids = np.asarray(cohort, np.int64)
+            real = ids < self.fed.num_clients  # poisson pads never churn
+            crashed, frac = self._churn.crashed(round_idx, ids)
+            crashed &= real
+            if crashed.any():
+                # crash-mid-round: truncate at the hash-drawn fraction
+                # of the FULL step grid (≥ 1 step — a crash during
+                # step 1 still uploads that step's work)
+                done = np.maximum(
+                    1, np.floor(frac * shape.steps).astype(np.int64)
+                )
+                mask = mask.copy()
+                if spec_mode:
+                    mask[crashed, 1] = np.minimum(
+                        mask[crashed, 1], done[crashed]
+                    )
+                    n_ex = spec_examples(mask, shape)
+                else:
+                    cut = (
+                        np.arange(shape.steps)[None, :] < done[crashed, None]
+                    )
+                    mask[crashed] = mask[crashed] * cut[:, :, None].astype(
+                        mask.dtype
+                    )
+                    n_ex = mask.sum((1, 2))
+                n_crash = int(crashed.sum())
+            offline = ~self._churn.available(round_idx, ids) & real
+            hazard = self._churn.dropped(round_idx, ids) & real
+            churn_drop = offline | hazard
+            if churn_drop.any():
+                n_ex = n_ex * (~churn_drop).astype(np.float32)
+                n_unavail = int(offline.sum())
+                n_hazard = int((hazard & ~offline).sum())
         if (round_idx is not None and self._counters_on
-                and (n_strag or n_drop)):
-            self._fail_stats[round_idx] = {
-                "straggler_clients": n_strag,
-                "dropped_clients": n_drop,
-            }
+                and (n_strag or n_drop or n_unavail or n_hazard or n_crash)):
+            stats = {}
+            if n_strag or n_drop:
+                stats["straggler_clients"] = n_strag
+                stats["dropped_clients"] = n_drop
+            if n_unavail:
+                stats["churn_unavailable"] = n_unavail
+            if n_hazard:
+                stats["churn_dropped"] = n_hazard
+            if n_crash:
+                stats["churn_crashed"] = n_crash
+            self._fail_stats[round_idx] = stats
         return mask, n_ex
 
     def _prefetch_spe(self, round_idx: int) -> Optional[int]:
@@ -1835,28 +1922,155 @@ class Experiment:
         The pop-K-earliest discipline with durations ≤ S and concurrency
         K·S bounds realized staleness by 2S (a finished client waits at
         most concurrency/K = S further steps), which sizes the 2S+1-slot
-        ring — asserted, not assumed."""
+        ring. Without churn the bound is an invariant (violations
+        raise); under ``run.churn`` offline clients DEFER completions
+        and the bound becomes a BUDGET — the admission gate clamps an
+        over-bound update's start version to the oldest retained ring
+        slot, decays its weight at the TRUE staleness (strictly
+        stronger), counts it (``staleness_clamped``), and warns once.
+        ``run.strict_staleness=true`` restores the raise.
+
+        Million-client plane (the churn PR): ``data.placement=stream``
+        gathers only the popped buffer's example rows into the
+        fixed-shape slab (mmap store composes — the gather IS the
+        store read path), ``server.sampling=streaming`` draws arrivals
+        through the O(cohort·log) sketch sampler (availability-gated,
+        Oort-scored once per-insert ledger stats feed the sketch), and
+        ``run.obs.client_ledger`` rides the round program per insert.
+        ``server.async_backlog_cap`` sheds completed backlog beyond
+        the cap per ``async_overload_policy`` (drop-oldest vs
+        reject-newest; shed clients re-enter as fresh arrivals at the
+        current version, their in-flight work discarded and counted)."""
         cfg = self.cfg
         s_max = cfg.server.async_max_staleness
         window = 2 * s_max + 1
         k = cfg.server.cohort_size
         version = round_idx
         host_rng = np.random.default_rng((cfg.run.seed, 6073, round_idx))
+        if (self._snapshot_refresh and round_idx > 0
+                and round_idx % self._ledger_cfg.log_every == 0):
+            # streaming-sketch refresh from the per-insert ledger, at
+            # the same log_every boundaries as the sync loop — arrival
+            # draws for rounds [r, r + log_every) are a pure function
+            # of (seed, round, sketch@r)
+            self._refresh_adaptive_snapshot(round_idx)
 
+        n_bp_drop = n_bp_rej = 0
         with self.tracer.span("round.async_schedule"):
-            order = np.lexsort((state["queue_seq"], state["queue_finish"]))
+            if self._churn is not None:
+                # availability-aware pop: an offline client's
+                # completion cannot be absorbed — it WAITS (sorted
+                # behind every online entry), so its staleness
+                # accumulates while the device is dark, exactly the
+                # production regime the admission gate below absorbs.
+                # Stateless by construction (the availability bit is
+                # the pure churn hash — nothing mutates, so resume
+                # replays the same pops). When fewer than K online
+                # completions exist, offline entries fill the static-
+                # shape pop and realize as churn dropouts (weight 0)
+                # in _apply_failures, their slots re-queued fresh.
+                offline = (
+                    ~self._churn.available(
+                        round_idx, state["queue_clients"]
+                    )
+                ).astype(np.int32)
+                order = np.lexsort((
+                    state["queue_seq"], state["queue_finish"], offline,
+                ))
+            else:
+                order = np.lexsort(
+                    (state["queue_seq"], state["queue_finish"])
+                )
             pick = order[:k]
+            cap = cfg.server.async_backlog_cap
+            if cap > 0:
+                # overload backpressure: completed entries beyond the
+                # K this step absorbs form the backlog; anything past
+                # the cap is shed per policy — the client re-enters as
+                # a fresh arrival at the current version, its
+                # in-flight work discarded (counted)
+                done = np.flatnonzero(
+                    state["queue_finish"] <= round_idx
+                )
+                waiting = np.setdiff1d(done, pick, assume_unique=False)
+                excess = len(waiting) - cap
+                if excess > 0:
+                    if cfg.server.async_overload_policy == "drop_oldest":
+                        # shed the stalest waiters (oldest start
+                        # version first; ties by arrival order)
+                        shed_order = np.lexsort((
+                            state["queue_seq"][waiting],
+                            state["queue_versions"][waiting],
+                        ))
+                        shed = waiting[shed_order[:excess]]
+                        n_bp_drop = excess
+                    else:  # reject_newest: FIFO admission
+                        shed_order = np.lexsort((
+                            -state["queue_seq"][waiting],
+                            -state["queue_versions"][waiting],
+                        ))
+                        shed = waiting[shed_order[:excess]]
+                        n_bp_rej = excess
+                    state["queue_versions"][shed] = version + 1
+                    state["queue_finish"][shed] = (
+                        round_idx + 1 + self._client_durations(
+                            state["queue_clients"][shed], host_rng
+                        )
+                    ).astype(np.int32)
+                    nxt_shed = state["queue_next_seq"]
+                    state["queue_seq"][shed] = np.arange(
+                        nxt_shed, nxt_shed + excess, dtype=np.int32
+                    )
+                    state["queue_next_seq"] = nxt_shed + excess
             cohort = state["queue_clients"][pick].copy()
             staleness = version - state["queue_versions"][pick]
-        if not ((staleness >= 0).all() and (staleness <= 2 * s_max).all()):
-            # a violated bound would gather params from a wrong/overwritten
-            # ring slot with no runtime error — must survive python -O
+        if not (staleness >= 0).all():
+            # a negative staleness is a scheduler bug, never a churn
+            # outcome — must survive python -O
             raise RuntimeError(
                 f"fedbuff staleness bound violated: {staleness} outside "
                 f"[0, {2 * s_max}] — history ring sizing is wrong"
             )
-        slots = (state["queue_versions"][pick] % window).astype(np.int32)
-        self._async_stats[round_idx] = float(staleness.mean())
+        over = staleness > 2 * s_max
+        n_clamped = int(over.sum())
+        if n_clamped and cfg.run.strict_staleness:
+            # the pre-churn contract, preserved behind the escape
+            # hatch: the ring bound is an invariant
+            raise RuntimeError(
+                f"fedbuff staleness bound violated: {staleness} outside "
+                f"[0, {2 * s_max}] — history ring sizing is wrong"
+            )
+        # graceful admission: an update whose start version aged out of
+        # the ring trains against the OLDEST RETAINED version (slot
+        # arithmetic on the clamped version — the true start was
+        # overwritten), while its weight decays at the TRUE staleness
+        eff_versions = np.maximum(
+            state["queue_versions"][pick], version - 2 * s_max
+        )
+        slots = (eff_versions % window).astype(np.int32)
+        if n_clamped and not self._staleness_warned:
+            self._staleness_warned = True
+            self.logger.log({
+                "event": "warning",
+                "warning": "staleness_clamped",
+                "round": int(round_idx),
+                "detail": (
+                    f"fedbuff update(s) exceeded the 2S={2 * s_max} "
+                    f"staleness bound (max realized "
+                    f"{int(staleness.max())}): start version clamped "
+                    f"to the oldest retained ring slot, weight decayed "
+                    f"at the true staleness; counted as "
+                    f"staleness_clamped (warn-once; set "
+                    f"run.strict_staleness=true to make this an error)"
+                ),
+            })
+        self._async_stats[round_idx] = {
+            "mean": float(staleness.mean()),
+            "max": int(staleness.max()),
+            "clamped": n_clamped,
+            "bp_dropped": n_bp_drop,
+            "bp_rejected": n_bp_rej,
+        }
 
         with self.tracer.span("round.host_inputs"):
             idx, mask, n_ex = make_round_indices(
@@ -1864,14 +2078,10 @@ class Experiment:
             )
             mask, n_ex = self._apply_failures(mask, n_ex, k, host_rng,
                                               round_idx=round_idx,
-                                              shape=self.shape)
+                                              shape=self.shape,
+                                              cohort=cohort)
         if self._counters_on:
             self._comm_stats[round_idx] = self._round_comm(cohort, n_ex)
-        if self._population is not None:
-            # fedbuff pops its in-flight queue rather than sampling, so
-            # there is no draw-provenance split — coverage/fairness/
-            # staleness still track the realized server steps
-            self._population.observe_cohort(round_idx, cohort, n_ex, None)
         base_w = (
             n_ex if self._agg_mode == "examples"
             else (n_ex > 0).astype(np.float32)
@@ -1880,24 +2090,93 @@ class Experiment:
             base_w * (1.0 + staleness.astype(np.float32))
             ** -cfg.server.async_staleness_exponent
         )
+        self._async_absorbed += int((n_ex > 0).sum())
+        if self._population is not None:
+            self._population.observe_async(
+                round_idx, staleness, absorbed=int((n_ex > 0).sum()),
+                clamped=n_clamped, bp_dropped=n_bp_drop,
+                bp_rejected=n_bp_rej,
+            )
+
+        if self._stream:
+            # store-backed / larger-than-HBM corpora: gather only this
+            # step's example rows into the fixed-shape slab (the mmap
+            # store's gather path) and remap the index tensor into it
+            idx, slab_x, slab_y = self._stream_slab(idx)
+            if self._population is not None:
+                self._population.observe_slab(
+                    int(idx.size), int(len(np.unique(idx)))
+                )
+            train_x = self._put_data(jnp.asarray(slab_x))
+            train_y = self._put_data(jnp.asarray(slab_y))
+        else:
+            train_x, train_y = self.train_x, self.train_y
 
         put_c = lambda a: self._put(jnp.asarray(a), self._client_sharding)  # noqa: E731
         rng = jax.random.fold_in(state["rng_key"], round_idx)
+        common = (
+            state["history"], state["server_opt_state"], train_x, train_y,
+            put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
+            put_c(n_ex), put_c(slots),
+        )
+        ring = (
+            jnp.int32(version % window), jnp.int32((version + 1) % window),
+        )
+        ledger = None
         with self.tracer.span("round.dispatch"):
-            history, params, opt_state, metrics = self.round_fn(
-                state["history"], state["server_opt_state"],
-                self.train_x, self.train_y,
-                put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
-                put_c(n_ex), put_c(slots),
-                jnp.int32(version % window), jnp.int32((version + 1) % window),
-                rng,
-            )
+            if self._ledger_on:
+                # per-insert forensic stats + (optionally) the
+                # staleness-aware reputation-weighted merge: cohort ids
+                # and the carried ledger ride the program; the updated
+                # ledger comes back before the metrics
+                cohort_dev = self._put(
+                    jnp.asarray(np.asarray(cohort, np.int32)),
+                    self._data_sharding,
+                )
+                history, params, opt_state, ledger, metrics = self.round_fn(
+                    *common, cohort_dev, state["ledger"], *ring, rng,
+                )
+            else:
+                history, params, opt_state, metrics = self.round_fn(
+                    *common, *ring, rng,
+                )
 
-        # replace the popped clients: fresh draws starting at the NEW
-        # version, finishing 1..S steps from the next step
-        state["queue_clients"][pick] = host_rng.choice(
-            self.fed.num_clients, size=k, replace=k > self.fed.num_clients
-        ).astype(np.int32)
+        # replace the popped clients: fresh arrivals starting at the
+        # NEW version, finishing 1..S steps from the next step. The
+        # draw is uniform (churn-gated to online clients), or the
+        # streaming sketch sampler's O(cohort·log) draw — availability-
+        # gated and Oort-scored once ledger evidence feeds the sketch.
+        if self._streaming:
+            # the streaming sampler's draw is availability-gated and
+            # (with ledger evidence) Oort-scored; its deterministic
+            # backstop guarantees exactly K ids
+            arrivals = self.sampler.sample(round_idx).astype(np.int32)
+            arrival_draws = self.sampler.take_draw_stats(round_idx)
+        else:
+            if self._churn is not None:
+                all_ids = np.arange(self.fed.num_clients)
+                online = all_ids[self._churn.available(round_idx, all_ids)]
+                pool = online if len(online) else all_ids
+                arrivals = host_rng.choice(
+                    pool, size=k, replace=k > len(pool),
+                ).astype(np.int32)
+            else:
+                # churn-off keeps the exact pre-churn draw (int form —
+                # the bitwise-identity contract covers the rng stream)
+                arrivals = host_rng.choice(
+                    self.fed.num_clients, size=k,
+                    replace=k > self.fed.num_clients,
+                ).astype(np.int32)
+            arrival_draws = None
+        if self._population is not None:
+            # coverage/fairness track the REALIZED server step (pads
+            # and zero-weight failures excluded); the draw split — when
+            # present — describes this step's ARRIVALS (fedbuff pops
+            # its queue; the sampler only feeds it)
+            self._population.observe_cohort(
+                round_idx, cohort, n_ex, arrival_draws,
+            )
+        state["queue_clients"][pick] = arrivals
         state["queue_versions"][pick] = version + 1
         state["queue_finish"][pick] = (
             round_idx + 1
@@ -1906,7 +2185,7 @@ class Experiment:
         nxt = state["queue_next_seq"]
         state["queue_seq"][pick] = np.arange(nxt, nxt + k, dtype=np.int32)
 
-        return {
+        new_state = {
             "history": history,
             "params": params,
             "server_opt_state": opt_state,
@@ -1919,6 +2198,9 @@ class Experiment:
             "queue_next_seq": nxt + k,
             "_metrics": metrics,
         }
+        if self._ledger_on:
+            new_state["ledger"] = ledger
+        return new_state
 
     def _pairwise_seeds(self, round_idx: int, n_host: np.ndarray):
         """One round of the Bonawitz key protocol, host-side
@@ -2629,6 +2911,9 @@ class Experiment:
         self._total_compiles = 0
         self._total_compile_ms = 0.0
         self._ledger_logged_round = -1
+        self._traffic_totals = {}
+        self._async_absorbed = 0
+        self._staleness_warned = False
         self._db_stats = {k: 0 for k in self._db_stats}
         # Checkpoint provenance baseline: only checkpoints written BY THIS
         # fit() call may be restored on retry — restoring a stale
@@ -2725,6 +3010,27 @@ class Experiment:
                         "ledger_evictions": int(self._pager.evictions),
                         "ledger_page_syncs": int(self._pager.page_syncs),
                     } if self._pager is not None else {}),
+                    # production-traffic totals (run.churn / fedbuff):
+                    # staleness clamps, backpressure sheds, realized
+                    # churn counts — present only on runs that saw them
+                    **{k: int(v) for k, v in sorted(
+                        self._traffic_totals.items()
+                    )},
+                    # the async throughput headline: updates absorbed
+                    # (weight > 0 at admission) per wall-clock second,
+                    # at the configured staleness bound — the number
+                    # the async_throughput bench entry reads
+                    **({
+                        "async_updates_absorbed": int(self._async_absorbed),
+                        "async_updates_per_sec": round(
+                            self._async_absorbed
+                            / max(time.perf_counter() - self._fit_t0, 1e-9),
+                            3,
+                        ),
+                        "async_staleness_bound": int(
+                            2 * self.cfg.server.async_max_staleness
+                        ),
+                    } if self.fedbuff else {}),
                     # population totals (run.obs.population): lifetime
                     # coverage / participation / pager hit rate / store
                     # bytes — `colearn summarize` renders these
@@ -2888,6 +3194,20 @@ class Experiment:
                 # aborting mechanism (see dp_client_epsilon)
                 "dp_delta_abort": float(self.dp_delta_abort()),
             })
+        if start_round == 0 and self._churn is not None:
+            # churn provenance: the full hazard model, so any staleness
+            # / dropout / convergence number in this log can be
+            # attributed to the traffic shape it ran under
+            cch = cfg.run.churn
+            self.logger.log({
+                "event": "churn",
+                "diurnal_period": int(cch.diurnal_period),
+                "diurnal_amplitude": float(cch.diurnal_amplitude),
+                "base_availability": float(cch.base_availability),
+                "min_availability": float(cch.min_availability),
+                "dropout_hazard": float(cch.dropout_hazard),
+                "crash_rate": float(cch.crash_rate),
+            })
         if start_round == 0 and self._bucket_ladder is not None:
             # shape-bucket provenance: the ladder every round's grid is
             # drawn from (rungs in steps_per_epoch), plus the bound the
@@ -3034,8 +3354,35 @@ class Experiment:
                         self.dp_client_epsilon(ridx + 1), 4
                     )
                 if ridx in self._async_stats:
-                    record["mean_staleness"] = round(
-                        self._async_stats.pop(ridx), 3
+                    astat = self._async_stats.pop(ridx)
+                    record["mean_staleness"] = round(astat["mean"], 3)
+                    record["max_staleness"] = int(astat["max"])
+                    if astat.get("clamped"):
+                        record["staleness_clamped"] = int(astat["clamped"])
+                    if astat.get("bp_dropped"):
+                        record["backpressure_dropped"] = int(
+                            astat["bp_dropped"]
+                        )
+                    if astat.get("bp_rejected"):
+                        record["backpressure_rejected"] = int(
+                            astat["bp_rejected"]
+                        )
+                for key in ("staleness_clamped", "backpressure_dropped",
+                            "backpressure_rejected", "churn_unavailable",
+                            "churn_dropped", "churn_crashed"):
+                    if key in record:
+                        self._traffic_totals[key] = (
+                            self._traffic_totals.get(key, 0)
+                            + int(record[key])
+                        )
+                if self._population is not None and any(
+                    key in record for key in
+                    ("churn_unavailable", "churn_dropped", "churn_crashed")
+                ):
+                    self._population.observe_churn(
+                        record.get("churn_unavailable", 0),
+                        record.get("churn_dropped", 0),
+                        record.get("churn_crashed", 0),
                     )
                 if ridx in self._attack_stats:
                     # compromised clients sampled into this round's

@@ -64,11 +64,30 @@ class CohortSampler:
                  explore: float = 0.1,
                  staleness_gain: float = 1.0,
                  flag_suppress: float = 4.0,
-                 sketch_size: int = 4096):
+                 sketch_size: int = 4096,
+                 availability_fn=None):
         if cohort_size > num_clients:
             raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
         if mode not in ("fixed", "poisson", "adaptive", "streaming"):
             raise ValueError(f"unknown sampler mode {mode!r}")
+        # Churn gating (run.churn, server/churn.py): a PURE predicate
+        # ``(round_idx, ids) -> bool[len(ids)]`` — offline clients are
+        # rejected from the draw. Purity is what keeps the schedule a
+        # function of (seed, round[, sketch]) so resume/prefetch
+        # replay it; config.validate() restricts the pairing to the
+        # uniform and streaming modes.
+        if availability_fn is not None and mode not in ("fixed", "streaming"):
+            raise ValueError(
+                f"availability gating supports mode='fixed' (uniform) "
+                f"or 'streaming', not {mode!r}"
+            )
+        if availability_fn is not None and weights is not None:
+            raise ValueError(
+                "availability gating is incompatible with static "
+                "sampling weights (the gated draw is uniform over the "
+                "online set)"
+            )
+        self.availability_fn = availability_fn
         self.num_clients = num_clients
         self.cohort_size = cohort_size
         self.seed = seed
@@ -268,7 +287,7 @@ class CohortSampler:
                 return
             out.add(c)
 
-    def _sample_streaming(self, rng) -> np.ndarray:
+    def _sample_streaming(self, rng, round_idx: int) -> np.ndarray:
         """O(cohort·log sketch) cohort draw: each slot draws from the
         exploration floor (uniform over all N), the sketch table
         (binary search over the score cumsum), or the unseen pool
@@ -280,6 +299,10 @@ class CohortSampler:
         the rng stream is exactly the pre-tally stream."""
         n, k = self.num_clients, self.cohort_size
         draws = {"explore": 0, "scored": 0, "unseen": 0}
+        if self.availability_fn is not None:
+            # churn gating: offline candidates rejected (tallied for
+            # the population draw-split panel; observation only)
+            draws["offline"] = 0
         self._last_draws = draws
         out: set = set()
         sk = self._sketch
@@ -314,6 +337,14 @@ class CohortSampler:
                     if cand in id_set:
                         continue  # landed on a seen id: not this pool's
             if cand in out:
+                continue
+            if (self.availability_fn is not None
+                    and not bool(self.availability_fn(
+                        round_idx, np.asarray([cand], np.int64))[0])):
+                # offline this round (run.churn): reject and redraw —
+                # the predicate is pure in (round, id), so the rng
+                # stream (and hence the schedule) stays replayable
+                draws["offline"] += 1
                 continue
             out.add(cand)
             draws[pool] += 1
@@ -350,8 +381,29 @@ class CohortSampler:
             self._note_draws(round_idx, {"uniform": len(out)})
             return out
         if self.mode == "streaming":
-            out = self._sample_streaming(rng)
+            out = self._sample_streaming(rng, round_idx)
             self._note_draws(round_idx, self._last_draws)
+            return out
+        if self.mode == "fixed" and self.availability_fn is not None:
+            # availability-gated uniform draw (run.churn): uniform
+            # without replacement over the ONLINE set. When the
+            # diurnal trough leaves fewer online clients than the
+            # cohort, every online client participates and the
+            # smallest offline ids fill the remaining slots
+            # deterministically — they realize as churn dropouts in
+            # the driver's failure path, which is exactly what
+            # dispatching to an offline device does.
+            all_ids = np.arange(self.num_clients)
+            online = all_ids[self.availability_fn(round_idx, all_ids)]
+            if len(online) >= self.cohort_size:
+                out = np.sort(rng.choice(
+                    online, size=self.cohort_size, replace=False
+                ))
+            else:
+                offline = np.setdiff1d(all_ids, online)
+                fill = offline[: self.cohort_size - len(online)]
+                out = np.sort(np.concatenate([online, fill]))
+            self._note_draws(round_idx, {"uniform": len(out)})
             return out
         out = np.sort(
             rng.choice(self.num_clients, size=self.cohort_size,

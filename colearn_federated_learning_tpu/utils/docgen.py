@@ -101,6 +101,23 @@ _SECTIONS = [
      "ladder-size extra compiles per engine (attributed per rung via "
      "the obs compile listener's `shape_bucket` events). See "
      "docs/DESIGN.md \"Shape buckets & retrace policy\"."),
+    ("run.churn", config_mod.ChurnConfig,
+     "Seed-pure availability/churn model (server/churn.py) — the "
+     "production-traffic plane: per-client diurnal availability waves "
+     "(hash-derived phase per client), a mid-round dropout hazard, and "
+     "crash-mid-round injection at a hash-drawn work fraction. Every "
+     "draw is a pure function of (run.seed, round, client_id) by "
+     "counter-mode hashing, so schedules are resume-replayable with "
+     "zero checkpoint state and engine-invariant. Gates the uniform "
+     "and streaming samplers (offline candidates rejected); dispatched "
+     "members realize failures through the existing straggler/dropout "
+     "machinery (crash -> mask truncation, offline/hazard -> weight "
+     "zeroing); under algorithm=fedbuff offline clients defer "
+     "completions, growing realized staleness toward the bounded-"
+     "staleness admission gate (run.strict_staleness) and the "
+     "server.async_backlog_cap backpressure policy. churn off "
+     "constructs nothing and is bitwise-identical to pre-churn builds. "
+     "See docs/DESIGN.md \"Churn & async production traffic\"."),
     ("run.obs", config_mod.ObsConfig,
      "Observability: round-lifecycle phase spans (+ optional Chrome-trace "
      "export), communication/device counters, and NaN/divergence health "
@@ -122,9 +139,11 @@ _SECTIONS = [
      "provenance event's ground-truth compromised set. Rejected "
      "pairings with reasons: secure_aggregation (masking hides exactly "
      "these statistics), client-level DP (a per-client disclosure "
-     "channel), gossip/fedbuff (no synchronous cohort upload stack), "
-     "scaffold/feddyn (stateful store plumbing). See docs/DESIGN.md "
-     "\"Client ledger & attack attribution\"."),
+     "channel), gossip (no server-visible upload stack), scaffold/"
+     "feddyn (stateful store plumbing). algorithm=fedbuff is SUPPORTED "
+     "via per-insert stats over each async server step's popped buffer "
+     "(dense ledger only — hot_capacity paging stays synchronous). See "
+     "docs/DESIGN.md \"Client ledger & attack attribution\"."),
     ("run.obs.population", config_mod.PopulationConfig,
      "Federation health observatory (obs/population.py): per-flush-"
      "window `population_health` JSONL records covering the data "

@@ -388,7 +388,10 @@ def test_clients_cli_errors_without_ledger(tmp_path, capsys):
      "secure_aggregation"),
     ({"server.dp_client_noise_multiplier": 1.0,
       "server.clip_delta_norm": 1.0}, "client-level DP"),
-    ({"algorithm": "fedbuff"}, "fedbuff"),
+    # fedbuff × dense ledger is SUPPORTED since the churn PR (per-
+    # insert stats); the pager's slot remap stays synchronous-only
+    ({"algorithm": "fedbuff",
+      "run.obs.client_ledger.hot_capacity": 64}, "fedbuff"),
     ({"algorithm": "scaffold", "client.momentum": 0.0}, "scaffold"),
     ({"run.obs.client_ledger.ema": 0.0}, "ema"),
     ({"run.obs.client_ledger.zmax": -1.0}, "zmax"),
