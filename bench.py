@@ -767,6 +767,11 @@ def bench_async_throughput(name: str):
                 "updates_absorbed": int(absorbed),
                 "staleness_bound": 2 * s_max,
                 "max_realized_staleness": int(max_stale),
+                # pooled per-update staleness quantiles over the timed
+                # window (exact — the driver keeps a value → count
+                # histogram, no sampling)
+                "staleness_p50": exp._staleness_percentiles()[0],
+                "staleness_p90": exp._staleness_percentiles()[1],
                 "staleness_clamped": int(clamped),
                 "backpressure_shed": int(bp),
                 "async_overload_policy": cfg.server.async_overload_policy,
@@ -785,6 +790,171 @@ def bench_async_throughput(name: str):
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# Hierarchical multi-version async entry (ISSUE 16 acceptance): the
+# FedBuff plane at 10⁶ store-backed clients with TWO concurrent model
+# versions (server.async_versions), FOUR edge aggregators grouping the
+# popped buffer (server.hierarchy, reputation-trust core, 10% edge
+# dropout), and trace-replay availability (run.churn.trace) instead of
+# the analytic diurnal model. Headline: updates/sec ABSORBED at the
+# staleness bound; extras break the absorbed count down per tier (edge)
+# and per version. BENCH_BUDGETS.json gates it TWICE — the throughput
+# floor (`async_updates_per_sec_min`) and the realized-staleness
+# ceiling (`hier_async_staleness_bound`) — so a regression that keeps
+# throughput by letting staleness run away still fails the report.
+_HIER_ASYNC_SCALE = {
+    "hier_async_1m": 1_000_000,
+}
+
+
+def bench_hier_async(name: str):
+    import shutil
+    import tempfile
+
+    import jax
+
+    from colearn_federated_learning_tpu.config import get_named_config
+    from colearn_federated_learning_tpu.data.store import (
+        build_synthetic_store,
+    )
+    from colearn_federated_learning_tpu.server.churn import (
+        build_synthetic_trace,
+    )
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    n = _HIER_ASYNC_SCALE[name]
+    warmup, timed = 2, 8
+    s_max, versions, edges = 2, 2, 4
+    tmp = tempfile.mkdtemp(prefix=f"bench_{name}_")
+    try:
+        t_build0 = time.perf_counter()
+        build_synthetic_store(
+            tmp, num_clients=n, examples_per_client=2, shape=(12, 12, 1),
+            num_classes=10, seed=0, test_examples=64,
+        )
+        build_sec = time.perf_counter() - t_build0
+        trace = build_synthetic_trace(
+            os.path.join(tmp, "avail_trace"), rounds=64, rows=4096,
+            seed=0, diurnal_period=8,
+        )
+        cfg = get_named_config("mnist_fedavg_2")
+        cfg.apply_overrides({
+            "algorithm": "fedbuff",
+            "data.num_clients": n, "data.store.dir": tmp,
+            "data.placement": "stream", "server.sampling": "streaming",
+            "server.cohort_size": 16, "client.batch_size": 2,
+            "server.num_rounds": warmup + timed, "server.eval_every": 0,
+            "server.checkpoint_every": 0, "run.out_dir": "",
+            "server.async_max_staleness": s_max,
+            "server.async_backlog_cap": 8,
+            # the tentpole knobs: concurrent model lines + edge tier
+            "server.async_versions": versions,
+            "server.async_retire_rounds": 6,
+            "server.hierarchy.num_edges": edges,
+            "server.hierarchy.core_aggregator": "reputation",
+            "server.hierarchy.edge_dropout_rate": 0.1,
+            "run.obs.population.enabled": True,
+            # availability from a recorded on/off trace, not the
+            # analytic diurnal wave (seed-pure row hash, O(cohort))
+            "run.churn.enabled": True,
+            "run.churn.trace": trace,
+            "run.churn.dropout_hazard": 0.02,
+        })
+        cfg.validate()
+        exp = Experiment(cfg, echo=False)
+        state = exp._place_state(exp.init_state())
+        for r in range(warmup):
+            state = exp.run_round(state, r)
+            state.pop("_metrics")
+        absorbed0 = exp._async_absorbed
+        t0 = time.perf_counter()
+        pending = []
+        for r in range(warmup, warmup + timed):
+            state = exp.run_round(state, r)
+            pending.append(state.pop("_metrics"))
+        fetched = jax.device_get(pending)
+        dt = time.perf_counter() - t0
+        absorbed = exp._async_absorbed - absorbed0
+        astats = [exp._async_stats[r] for r in range(warmup, warmup + timed)
+                  if r in exp._async_stats]
+        max_stale = max((a["max"] for a in astats), default=0)
+        p50, p90, _hist_max = exp._staleness_percentiles()
+        updates_per_sec = absorbed / dt if dt > 0 else 0.0
+        floor = bound = None
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "BENCH_BUDGETS.json")) as f:
+                budgets = json.load(f)
+            floor = budgets.get("async_updates_per_sec_min")
+            bound = budgets.get("hier_async_staleness_bound")
+        except (OSError, json.JSONDecodeError):
+            pass
+        meets = None
+        if floor is not None or bound is not None:
+            meets = bool(
+                (floor is None or updates_per_sec >= float(floor))
+                and (bound is None or max_stale <= int(bound))
+            )
+        pop_totals = exp._population.summary_totals(
+            None, (exp.fed.train_x, exp.fed.train_y)
+        )
+        return {
+            "metric": (
+                f"hier async updates/sec absorbed at staleness <= "
+                f"{2 * s_max} ({n}-client mmap store, fedbuff × "
+                f"{versions} versions × {edges} edges, trace churn)"
+            ),
+            "value": round(updates_per_sec, 4),
+            "unit": "updates/sec",
+            "vs_baseline": 1.0,
+            "extra": {
+                "static_check": _static_check_extra(),
+                "num_clients": n,
+                "store_backed": True,
+                "store_build_sec": round(build_sec, 2),
+                "placement": "stream",
+                "sampler": "streaming",
+                "population": True,
+                "churn": True,
+                "churn_trace": True,
+                "async_versions": versions,
+                "hier_edges": edges,
+                "edge_dropout_rate": 0.1,
+                "core_aggregator": "reputation",
+                "platform": jax.devices()[0].platform,
+                "timed_rounds": timed,
+                "rounds_per_sec": round(timed / dt, 4) if dt > 0 else 0.0,
+                "updates_absorbed": int(absorbed),
+                "staleness_bound": 2 * s_max,
+                "max_realized_staleness": int(max_stale),
+                "staleness_p50": p50,
+                "staleness_p90": p90,
+                # per-tier / per-version absorbed breakdown — the
+                # ISSUE 16 acceptance readout (a starved version or a
+                # dead edge reads ~0 in its bucket)
+                "per_version_absorbed": {
+                    str(v): int(c)
+                    for v, c in enumerate(exp._per_version_absorbed[:versions])
+                },
+                "per_edge_absorbed": {
+                    str(e): int(c) for e, c in enumerate(exp._edge_absorbed)
+                },
+                "version_readmitted": int(exp._version_readmitted),
+                "final_train_loss": round(
+                    float(fetched[-1].train_loss), 4
+                ),
+                "peak_host_rss_mb": _peak_host_rss_mb(),
+                "coverage_pct": pop_totals.get("population_coverage_pct"),
+                "budget_floor_updates_per_sec": floor,
+                "budget_staleness_bound": bound,
+                "meets_budget": meets,
+                "lora": False,
+                "cohort_layout": cfg.run.cohort_layout,
             },
         }
     finally:
@@ -1030,7 +1200,8 @@ def main(argv=None):
     ap.add_argument("--config", default="cifar10_fedavg_100",
                     choices=(sorted(_SHAPES) + sorted(_STORE_SCALE)
                              + sorted(_LORA_SCALE) + sorted(_WEAK_SCALE)
-                             + sorted(_ASYNC_SCALE)))
+                             + sorted(_ASYNC_SCALE)
+                             + sorted(_HIER_ASYNC_SCALE)))
     ap.add_argument("--matrix", action="store_true",
                     help="bench every config; one JSON line each")
     args = ap.parse_args(argv)
@@ -1043,6 +1214,8 @@ def main(argv=None):
             print(json.dumps(bench_store_scale(args.config)), flush=True)
         elif args.config in _ASYNC_SCALE:
             print(json.dumps(bench_async_throughput(args.config)), flush=True)
+        elif args.config in _HIER_ASYNC_SCALE:
+            print(json.dumps(bench_hier_async(args.config)), flush=True)
         else:
             print(json.dumps(bench_config(args.config)), flush=True)
         return
@@ -1054,7 +1227,7 @@ def main(argv=None):
 
     for name in (sorted(_SHAPES) + sorted(_STORE_SCALE)
                  + sorted(_LORA_SCALE) + sorted(_WEAK_SCALE)
-                 + sorted(_ASYNC_SCALE)):
+                 + sorted(_ASYNC_SCALE) + sorted(_HIER_ASYNC_SCALE)):
         proc = subprocess.run(
             [sys.executable, __file__, "--config", name],
             capture_output=True, text=True,

@@ -170,6 +170,18 @@ FEATURES: Dict[str, Feature] = {
                      "model.lora.enabled": True, "model.lora.rank": 2},
                     False, "adapter-plane uploads (params ARE the "
                     "adapters; engine-transparent by construction)"),
+    "hierarchy": Feature({"server.hierarchy.num_edges": 2}, True,
+                         "two-tier edge/core federation (the engine "
+                         "reused recursively, one tier down)"),
+    "multi_version": Feature({"algorithm": "fedbuff",
+                              "server.async_versions": 2}, False,
+                             "concurrent model versions, one async "
+                             "buffer each (fedbuff scheduler level)"),
+    "churn_trace": Feature({"run.churn.enabled": True,
+                            "run.churn.trace": "<trace>"}, False,
+                           "trace-replay availability (recorded on/off "
+                           "bitmap; dir is a validate-level sentinel, "
+                           "existence checked at model construction)"),
 }
 
 
@@ -234,6 +246,7 @@ def mirror_kwargs(cfg: ExperimentConfig) -> Dict[str, Any]:
         fused_apply=cfg.server.fused_apply,
         cohort_layout=cfg.run.cohort_layout,
         example_dp=cfg.dp.enabled,
+        hierarchy=cfg.server.hierarchy.num_edges > 0,
     )
 
 

@@ -72,9 +72,13 @@ REGISTRY: Dict[str, RecordSpec] = {
             "padded_step_fraction", "padded_example_fraction",
             "shape_bucket_steps", "dropped_clients", "straggler_clients",
             "dp_epsilon", "dp_client_epsilon", "mean_staleness",
-            "max_staleness", "staleness_clamped", "backpressure_dropped",
+            "max_staleness", "staleness_p50", "staleness_p90",
+            "staleness_clamped", "backpressure_dropped",
             "backpressure_rejected", "churn_unavailable", "churn_dropped",
             "churn_crashed",
+            # multi-version / hierarchy planes (ISSUE 16)
+            "async_version", "version_readmitted", "hier_edge_crashed",
+            "hier_edge_excluded", "hier_core_upload_bytes",
             "byzantine_count", "consensus_dist", "rounds_per_sec",
             "client_updates_per_sec_per_chip", "eval_loss", "eval_acc",
         ),
@@ -110,6 +114,12 @@ REGISTRY: Dict[str, RecordSpec] = {
             "backpressure_rejected", "churn_unavailable", "churn_dropped",
             "churn_crashed", "async_updates_absorbed",
             "async_updates_per_sec", "async_staleness_bound",
+            # multi-version / hierarchy planes (ISSUE 16)
+            "async_staleness_p50", "async_staleness_p90",
+            "async_staleness_max", "async_per_version",
+            "version_readmitted", "hier_edges", "hier_edge_absorbed",
+            "hier_edge_crashed", "hier_edge_excluded",
+            "hier_core_upload_bytes",
         ),
         doc="end-of-fit totals (every exit path, aborts included)",
     ),
@@ -121,7 +131,20 @@ REGISTRY: Dict[str, RecordSpec] = {
         required=("diurnal_period", "diurnal_amplitude",
                   "base_availability", "min_availability",
                   "dropout_hazard", "crash_rate"),
+        optional=("trace", "trace_rounds", "trace_rows"),
         doc="churn hazard-model provenance at fit start (run.churn)",
+    ),
+    "hierarchy": RecordSpec(
+        required=("num_edges", "core_aggregator", "edge_aggregator",
+                  "edge_dropout_rate", "core_trust_decay"),
+        doc="two-tier topology provenance at fit start "
+            "(server.hierarchy)",
+    ),
+    "async_versions": RecordSpec(
+        required=("versions", "retire_rounds", "retire_updates",
+                  "readmit_decay", "strict_versions"),
+        doc="multi-version scheduler provenance at fit start "
+            "(server.async_versions > 1)",
     ),
     "resumed": RecordSpec(
         required=("round", "host_pipeline"),

@@ -261,6 +261,61 @@ class AdaptiveSamplerConfig:
 
 
 @dataclass
+class HierarchyConfig:
+    """Two-tier (device → edge → core) federation (``server.hierarchy``,
+    server/round_driver.py). ``num_edges = E > 0`` splits the client
+    universe into E deterministic contiguous blocks (client ``i``
+    belongs to edge ``i·E // num_clients``); each edge aggregator runs
+    the EXISTING round program over a cohort drawn from its own block
+    (per-edge deterministic samplers), and the core round aggregates the
+    E edge deltas — the engine reused recursively, one tier down.
+
+    Per-tier robust aggregation composes: ``server.aggregator`` is the
+    EDGE tier's defense (e.g. krum over each edge's cohort) and
+    ``core_aggregator`` the core tier's (e.g. the reputation-weighted
+    mean over edge deltas) — a compromised edge is degraded at the core
+    even when its in-edge defense was overwhelmed. Edge-dropout fault
+    injection (``edge_dropout_rate``) crashes whole edges with a
+    seed-pure per-(round, edge) hash draw: a crashed edge's delta is
+    EXCLUDED from the core aggregate and counted
+    (``hier_edge_crashed``), never NaN-poisoning the core.
+
+    Under ``algorithm="fedbuff"`` the hierarchy rides the async
+    scheduler instead: each popped completion is grouped by its
+    client's edge, a crashed edge's completions are excluded for that
+    server step, and per-edge trust (``core_aggregator="reputation"``)
+    multiplies the staleness-decayed weights — per-tier absorbed/
+    staleness accounting lands in round records and run_summary.
+
+    Sync-path pairing restrictions live in ``validate()`` with reasons
+    (stateful algorithms, secure aggregation, DP accounting, the client
+    ledger, stream placement, fused rounds — each assumes exactly one
+    cohort dispatch per round). ``num_edges = 0`` constructs nothing
+    and is bitwise-identical to the flat plane (test-pinned)."""
+
+    # number of edge aggregators; 0 = hierarchy off (the flat plane)
+    num_edges: int = 0
+    # core-tier aggregation over the [E] stacked edge deltas:
+    #   mean        — participation-weighted mean (crashed edges excluded)
+    #   median | trimmed_mean | krum — the robust_reduce order
+    #                 statistics, one tier up (sync path only)
+    #   reputation  — trust-weighted mean; per-edge trust is an EMA of
+    #                 the edge's crash/alive history (edge_trust rides
+    #                 the checkpoint, so resume replays core weights)
+    core_aggregator: str = "mean"
+    # trimmed_mean core only: fraction trimmed from each side
+    core_trim_ratio: float = 0.1
+    # krum core only: assumed Byzantine edge count f
+    core_krum_byzantine: int = 0
+    # core_aggregator="reputation" only: EMA rate of the per-edge trust
+    # update trust ← (1-decay)·trust + decay·alive
+    core_trust_decay: float = 0.25
+    # per-(round, edge) probability that an edge aggregator crashes for
+    # that round (seed-pure hash draw; its delta is excluded + counted)
+    edge_dropout_rate: float = 0.0
+
+
+@dataclass
 class ServerConfig:
     num_rounds: int = 10
     cohort_size: int = 2
@@ -337,6 +392,36 @@ class ServerConfig:
     #   reject_newest — shed the most recent completions (FIFO
     #                  admission; the oldest waiters keep their slot)
     async_overload_policy: str = "drop_oldest"  # drop_oldest | reject_newest
+    # algorithm=fedbuff only: number of CONCURRENT model versions
+    # ("lines"), each with its own in-flight buffer, params trajectory,
+    # and 2S+1 history ring. Server steps round-robin over the lines
+    # (round r drives line r mod V at line-local version r div V); the
+    # availability-aware pop routes each completion to the line it was
+    # admitted by, and staleness is accounted per line in line-local
+    # steps. 1 (default) = the single-version plane, bitwise-identical
+    # to pre-multi-version builds (test-pinned). Line 0 is the primary
+    # version: eval, run_summary final loss, and `colearn export` read
+    # state["params"].
+    async_versions: int = 1
+    # Version retirement (async_versions >= 2 only; 0 = never retire).
+    # When a line reaches this AGE (line-local server steps since its
+    # generation was born) at its turn, the generation retires: the
+    # line's params continue as the successor generation, but every
+    # completion still in flight against the retired generation is a
+    # LATE completion — popped later, it is re-admitted at the oldest
+    # live version (staleness clamped to 2S) with its weight decayed by
+    # async_readmit_decay, counted (`version_readmitted`) and warned
+    # once, rather than dropped. run.strict_versions=true restores a
+    # hard reject (RuntimeError) for late completions.
+    async_retire_rounds: int = 0
+    # retire a line's generation once it has ABSORBED this many updates
+    # (whichever of age/updates trips first; 0 = no update threshold)
+    async_retire_updates: int = 0
+    # weight multiplier applied to a late completion re-admitted after
+    # its generation retired (composes with the staleness decay)
+    async_readmit_decay: float = 0.5
+    # Two-tier edge/core aggregation — see HierarchyConfig.
+    hierarchy: HierarchyConfig = field(default_factory=HierarchyConfig)
     # algorithm=feddyn only: the dynamic-regularization coefficient α
     # (both the client proximal pull and the server h-correction scale)
     feddyn_alpha: float = 0.1
@@ -824,6 +909,19 @@ class ChurnConfig:
     # hash-drawn fraction of its local steps (partial work aggregates,
     # mask-truncated — the straggler path)
     crash_rate: float = 0.0
+    # Trace-replay availability: path to a FedScale-style per-client
+    # on/off trace (a .npy uint8 bitmap [trace_rounds, trace_rows];
+    # `server.churn.build_synthetic_trace` writes one). When set, the
+    # diurnal wave is REPLACED by trace playback: client i maps to a
+    # stable hash-derived trace row, round r reads row bit
+    # [r mod trace_rounds], and the availability probability is the bit
+    # clipped to [min_availability, 1] — an off-bit client keeps the
+    # exploration-floor probability, with the same seed-pure hash
+    # tie-breaking as the analytic wave (schedules stay O(cohort),
+    # engine-invariant, and resume-replayable; the trace file is
+    # mmap-read, never materialized). diurnal_* knobs are ignored.
+    # File existence is checked at Experiment construction.
+    trace: str = ""
 
 
 @dataclass
@@ -979,6 +1077,14 @@ class RunConfig:
     # bound. True = the pre-churn contract: any staleness > 2S raises
     # (ring sizing is then an invariant, not a budget).
     strict_staleness: bool = False
+    # algorithm=fedbuff with server.async_versions >= 2 only: what a
+    # late completion against a RETIRED version generation does. False
+    # (default) = the graceful drain: the completion is re-admitted at
+    # the oldest live version with its weight decayed by
+    # server.async_readmit_decay, counted (`version_readmitted`) and
+    # warned once. True = hard reject: a late completion raises
+    # (retirement then asserts the buffer drained before the threshold).
+    strict_versions: bool = False
     # Observability block (spans / counters / health) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
 
@@ -1159,6 +1265,200 @@ class ExperimentConfig:
                     f"{self.server.async_overload_policy!r}; expected "
                     f"'drop_oldest' or 'reject_newest'"
                 )
+            if self.server.async_versions < 1:
+                raise ValueError("server.async_versions must be >= 1")
+            if self.server.async_versions == 1:
+                if (self.server.async_retire_rounds
+                        or self.server.async_retire_updates):
+                    raise ValueError(
+                        "server.async_retire_rounds/async_retire_updates "
+                        "require server.async_versions >= 2 (retirement "
+                        "rotates version generations; the single-version "
+                        "plane has nothing to retire into)"
+                    )
+                if self.run.strict_versions:
+                    raise ValueError(
+                        "run.strict_versions requires server."
+                        "async_versions >= 2 (there are no version "
+                        "generations to enforce on the single-version "
+                        "plane)"
+                    )
+            if self.server.async_retire_rounds < 0:
+                raise ValueError("async_retire_rounds must be >= 0")
+            if self.server.async_retire_updates < 0:
+                raise ValueError("async_retire_updates must be >= 0")
+            if not 0.0 < self.server.async_readmit_decay <= 1.0:
+                raise ValueError(
+                    f"server.async_readmit_decay must be in (0, 1], "
+                    f"got {self.server.async_readmit_decay}"
+                )
+            if self.server.async_versions > 1 and self.run.fuse_rounds > 1:
+                raise ValueError(
+                    "server.async_versions >= 2 is incompatible with "
+                    "run.fuse_rounds (the line scheduler interleaves "
+                    "versions across server steps; a fused chunk would "
+                    "span lines)"
+                )
+        else:
+            if self.server.async_versions != 1:
+                raise ValueError(
+                    "server.async_versions requires algorithm='fedbuff' "
+                    "(concurrent model versions are an async-buffer "
+                    "concept; the synchronous round has exactly one)"
+                )
+            if self.run.strict_versions:
+                raise ValueError(
+                    "run.strict_versions requires algorithm='fedbuff' "
+                    "with server.async_versions >= 2"
+                )
+        hier = self.server.hierarchy
+        if hier.num_edges < 0:
+            raise ValueError("server.hierarchy.num_edges must be >= 0")
+        if hier.core_aggregator not in (
+            "mean", "median", "trimmed_mean", "krum", "reputation",
+        ):
+            raise ValueError(
+                f"unknown server.hierarchy.core_aggregator "
+                f"{hier.core_aggregator!r}"
+            )
+        if not 0.0 <= hier.core_trim_ratio < 0.5:
+            raise ValueError(
+                f"server.hierarchy.core_trim_ratio must be in [0, 0.5), "
+                f"got {hier.core_trim_ratio}"
+            )
+        if not 0.0 <= hier.edge_dropout_rate <= 1.0:
+            raise ValueError(
+                f"server.hierarchy.edge_dropout_rate must be in [0, 1], "
+                f"got {hier.edge_dropout_rate}"
+            )
+        if not 0.0 < hier.core_trust_decay <= 1.0:
+            raise ValueError(
+                f"server.hierarchy.core_trust_decay must be in (0, 1], "
+                f"got {hier.core_trust_decay}"
+            )
+        if hier.num_edges > 0:
+            if self.algorithm == "gossip":
+                raise ValueError(
+                    "server.hierarchy is incompatible with "
+                    "algorithm='gossip' (the decentralized engine has no "
+                    "edge/core tiers — its topology IS the aggregation "
+                    "structure)"
+                )
+            if self.algorithm == "fedbuff":
+                # the async path: edges group the popped buffer — robust
+                # order statistics at the core need the synchronized [E]
+                # delta stack the async scheduler never forms
+                if hier.core_aggregator not in ("mean", "reputation"):
+                    raise ValueError(
+                        f"server.hierarchy.core_aggregator="
+                        f"{hier.core_aggregator!r} requires the "
+                        f"synchronous round program; under "
+                        f"algorithm='fedbuff' the async scheduler never "
+                        f"forms the synchronized per-edge delta stack "
+                        f"order statistics need — use 'mean' or "
+                        f"'reputation'"
+                    )
+            else:
+                # the sync path: E invocations of the existing round
+                # program per round — everything that assumes exactly
+                # one cohort dispatch per round is rejected with its
+                # reason
+                if self.data.num_clients // hier.num_edges \
+                        < self.server.cohort_size:
+                    raise ValueError(
+                        f"server.hierarchy.num_edges={hier.num_edges}: "
+                        f"each edge block holds ~"
+                        f"{self.data.num_clients // hier.num_edges} "
+                        f"clients but must cover a full cohort of "
+                        f"{self.server.cohort_size}"
+                    )
+                if self.algorithm in ("scaffold", "feddyn"):
+                    raise ValueError(
+                        "server.hierarchy is incompatible with stateful "
+                        "algorithms (scaffold/feddyn scatter per-client "
+                        "state once per round; E edge invocations would "
+                        "apply E conflicting server-side corrections)"
+                    )
+                if self.server.error_feedback:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "server.error_feedback (the EF residual store "
+                        "rides the single-cohort round program)"
+                    )
+                if self.server.secure_aggregation:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "server.secure_aggregation (the mask ring is "
+                        "committed over ONE round cohort; per-edge "
+                        "cohorts would need per-edge key ceremonies)"
+                    )
+                if self.server.dp_client_noise_multiplier > 0.0:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with client-"
+                        "level DP (noise calibrated for one aggregate "
+                        "per round would be added once per edge — E "
+                        "times the analyzed mechanism)"
+                    )
+                if self.dp.enabled:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "dp.enabled (the DP-SGD accountant composes one "
+                        "cohort draw per round; E edge cohorts change "
+                        "the sampling probability the bound assumes)"
+                    )
+                if self.run.obs.client_ledger.enabled:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "run.obs.client_ledger (the device-resident "
+                        "ledger carry and its paging assume a single "
+                        "cohort scatter per round)"
+                    )
+                if self.server.optimizer != "mean":
+                    raise ValueError(
+                        "server.hierarchy requires server.optimizer="
+                        "'mean' (stateful/adaptive server optimizers "
+                        "are not tier-decomposable: each edge would "
+                        "evolve its own moment estimates and the core "
+                        "delta-space aggregate could not recombine "
+                        "them)"
+                    )
+                if self.server.sampling != "uniform":
+                    raise ValueError(
+                        f"server.hierarchy draws per-edge cohorts via "
+                        f"uniform sampling only; server.sampling="
+                        f"{self.server.sampling} is not supported "
+                        f"(size weights, Poisson q, adaptive scores, "
+                        f"and streaming sketches are parameterized on "
+                        f"the GLOBAL population, not per-edge blocks)"
+                    )
+                if self.data.placement != "hbm":
+                    raise ValueError(
+                        "server.hierarchy requires data.placement=hbm "
+                        "(the stream slab prefetch builds one cohort "
+                        "slab per round; per-edge cohorts would race "
+                        "it)"
+                    )
+                if self.run.fuse_rounds > 1:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "run.fuse_rounds (the fused scan compiles one "
+                        "cohort per round body; the edge fan-out is a "
+                        "host-side loop)"
+                    )
+                if self.run.shape_buckets.enabled:
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "run.shape_buckets (the bucket rung is sized by "
+                        "THE round's single sampled cohort; E per-edge "
+                        "cohorts would need E rungs per round)"
+                    )
+                if self.run.host_pipeline == "native":
+                    raise ValueError(
+                        "server.hierarchy is incompatible with "
+                        "run.host_pipeline='native' (the C++ pipeline "
+                        "prefetches one cohort per round; use 'auto' or "
+                        "'numpy')"
+                    )
         if self.algorithm == "scaffold":
             # the option-II control-variate identity cᵢ⁺ = (w₀−w_K)/(K·lr)
             # assumes plain SGD local steps (Karimireddy et al. 2020 §3);
@@ -1991,6 +2291,12 @@ class ExperimentConfig:
                 f"run.churn.crash_rate must be in [0, 1), "
                 f"got {ch.crash_rate}"
             )
+        if ch.trace and not ch.enabled:
+            raise ValueError(
+                "run.churn.trace requires run.churn.enabled (trace "
+                "replay is an availability model; enabled=false must "
+                "construct nothing)"
+            )
         if ch.enabled:
             if self.algorithm == "gossip":
                 raise ValueError(
@@ -2123,6 +2429,7 @@ class ExperimentConfig:
             "population": PopulationConfig,  # nested under run.obs
             "reputation": ReputationConfig,  # nested under server
             "adaptive": AdaptiveSamplerConfig,  # nested under server
+            "hierarchy": HierarchyConfig,  # nested under server
             "store": StoreConfig,  # nested under data
             "lora": LoRAConfig,  # nested under model
         }

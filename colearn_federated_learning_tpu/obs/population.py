@@ -246,6 +246,13 @@ class PopulationTracker:
         self._w_async_clamped = 0
         self._w_bp_dropped = 0
         self._w_bp_rejected = 0
+        # multi-version / hierarchy window accumulators: per-version
+        # absorbed counts (server.async_versions > 1), retired-
+        # generation re-admissions, and crashed-edge exclusions
+        # (server.hierarchy under fedbuff)
+        self._w_async_versions: Dict[int, int] = {}
+        self._w_async_readmitted = 0
+        self._w_edge_crashed = 0
         # churn window accumulators (run.churn realized failures) —
         # fed at flush from the per-round failure stats
         self._w_churn = {"unavailable": 0, "dropped": 0, "crashed": 0}
@@ -299,18 +306,31 @@ class PopulationTracker:
 
     def observe_async(self, round_idx: int, staleness, *, absorbed: int,
                       clamped: int = 0, bp_dropped: int = 0,
-                      bp_rejected: int = 0) -> None:
+                      bp_rejected: int = 0, readmitted: int = 0,
+                      edge_crashed: int = 0,
+                      version: Optional[int] = None) -> None:
         """One fedbuff server step's scheduler facts: the popped
         buffer's realized staleness values, how many updates carried
         weight (arrival-rate numerator), and the clamp/backpressure
-        counts. Pure observation on the fit thread (the async
-        scheduler is never double-buffered)."""
+        counts. ``version`` is the model line this step drove
+        (server.async_versions > 1), ``readmitted`` late completions
+        folded back from a retired generation, ``edge_crashed`` edge
+        aggregators lost this step (server.hierarchy). Pure
+        observation on the fit thread (the async scheduler is never
+        double-buffered)."""
         s = np.asarray(staleness, np.float64).reshape(-1)
         self._w_async_steps += 1
         self._w_async_absorbed += int(absorbed)
         self._w_async_clamped += int(clamped)
         self._w_bp_dropped += int(bp_dropped)
         self._w_bp_rejected += int(bp_rejected)
+        self._w_async_readmitted += int(readmitted)
+        self._w_edge_crashed += int(edge_crashed)
+        if version is not None:
+            v = int(version)
+            self._w_async_versions[v] = (
+                self._w_async_versions.get(v, 0) + int(absorbed)
+            )
         if s.size:
             self._w_async_stale.append(float(s.mean()))
             self._w_async_max_stale = max(
@@ -486,6 +506,18 @@ class PopulationTracker:
                 a["backpressure_dropped"] = self._w_bp_dropped
             if self._w_bp_rejected:
                 a["backpressure_rejected"] = self._w_bp_rejected
+            if self._w_async_versions:
+                # per-model-line absorbed counts for this window — the
+                # multi-version health panel (a starved line shows up
+                # as a near-zero bucket here long before its loss does)
+                a["per_version_absorbed"] = {
+                    str(v): int(n)
+                    for v, n in sorted(self._w_async_versions.items())
+                }
+            if self._w_async_readmitted:
+                a["version_readmitted"] = self._w_async_readmitted
+            if self._w_edge_crashed:
+                a["edge_crashed"] = self._w_edge_crashed
             rec["async"] = a
         if self._w_churn_seen:
             rec["churn"] = {k: int(v) for k, v in self._w_churn.items()}
@@ -505,6 +537,9 @@ class PopulationTracker:
         self._w_async_clamped = 0
         self._w_bp_dropped = 0
         self._w_bp_rejected = 0
+        self._w_async_versions = {}
+        self._w_async_readmitted = 0
+        self._w_edge_crashed = 0
         self._w_churn = {"unavailable": 0, "dropped": 0, "crashed": 0}
         self._w_churn_seen = False
         return rec
@@ -613,7 +648,11 @@ def watch_snapshot(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                       "pager_hit_rate", "ledger_evictions",
                       "ledger_page_syncs", "async_updates_per_sec",
                       "async_updates_absorbed", "staleness_clamped",
-                      "backpressure_dropped", "backpressure_rejected"):
+                      "backpressure_dropped", "backpressure_rejected",
+                      "async_staleness_p50", "async_staleness_p90",
+                      "async_staleness_max", "async_per_version",
+                      "version_readmitted", "hier_edges",
+                      "hier_edge_absorbed", "hier_edge_crashed"):
                 if k in rec:
                     snap[k] = rec[k]
             continue
@@ -761,6 +800,29 @@ def format_watch(snap: Dict[str, Any], path: str = "") -> str:
         if series:
             line += "  " + sparkline(series)
         lines.append(line)
+        # multi-version lines: absorbed per model line this window
+        # (a starved line reads ~0 here) plus retired-generation
+        # re-admissions; hierarchy: crashed-edge exclusions
+        pv = (asy or {}).get(
+            "per_version_absorbed", snap.get("async_per_version")
+        )
+        if pv:
+            vparts = [
+                f"v{v} {n}" for v, n in sorted(
+                    pv.items(), key=lambda kv: int(kv[0])
+                )
+            ]
+            readmit = (asy or {}).get(
+                "version_readmitted", snap.get("version_readmitted")
+            )
+            if readmit:
+                vparts.append(f"readmitted {readmit}")
+            lines.append("versions: " + "  ".join(vparts))
+        crashed_e = (asy or {}).get(
+            "edge_crashed", snap.get("hier_edge_crashed")
+        )
+        if crashed_e:
+            lines.append(f"edges: crashed {crashed_e}")
     chn = snap.get("churn")
     if chn:
         lines.append(

@@ -636,14 +636,18 @@ def _tail_async_records(doc, parsed) -> List[Dict[str, Any]]:
     candidates: List[Dict[str, Any]] = []
     for line in str(doc.get("tail") or "").splitlines():
         line = line.strip()
-        if not (line.startswith("{") and "async_throughput" in line):
+        if not (line.startswith("{") and (
+            "async_throughput" in line or "hier_async" in line
+        )):
             continue
         try:
             candidates.append(json.loads(line))
         except json.JSONDecodeError:
             continue
     if (
-        str(parsed.get("config") or "").startswith("async_throughput")
+        str(parsed.get("config") or "").startswith(
+            ("async_throughput", "hier_async")
+        )
         or (parsed.get("extra") or {}).get("staleness_bound") is not None
     ):
         candidates.append(parsed)
@@ -666,6 +670,12 @@ def _tail_async_records(doc, parsed) -> List[Dict[str, Any]]:
             "max_realized_staleness": extra.get("max_realized_staleness"),
             "staleness_clamped": extra.get("staleness_clamped"),
             "backpressure_shed": extra.get("backpressure_shed"),
+            # hierarchical multi-version entries (hier_async_*) carry
+            # the per-tier breakdown the staleness-bound gate prints
+            "hier_edges": extra.get("hier_edges"),
+            "async_versions": extra.get("async_versions"),
+            "per_version_absorbed": extra.get("per_version_absorbed"),
+            "per_edge_absorbed": extra.get("per_edge_absorbed"),
         })
     return records
 
@@ -800,6 +810,24 @@ def bench_report(entries: Sequence[Dict[str, Any]],
                     violations.append(
                         f"async updates/sec {rec['updates_per_sec']:.1f} "
                         f"< budget floor {float(ups_min):.1f} "
+                        f"({rec['name']}, {with_async[-1]['file']})"
+                    )
+    # hierarchical-async staleness ceiling: the hier_async_* entries
+    # gate on BOTH axes — the shared throughput floor above AND the
+    # realized-staleness bound here, so trading staleness for
+    # throughput cannot pass the report
+    stale_max = budgets.get("hier_async_staleness_bound")
+    if stale_max is not None:
+        with_async = [e for e in entries if e.get("async_throughput")]
+        if with_async:
+            for rec in with_async[-1]["async_throughput"]:
+                if "hier_async" not in rec["name"]:
+                    continue
+                ms = rec.get("max_realized_staleness")
+                if ms is not None and int(ms) > int(stale_max):
+                    violations.append(
+                        f"hier async realized staleness {int(ms)} "
+                        f"> budget bound {int(stale_max)} "
                         f"({rec['name']}, {with_async[-1]['file']})"
                     )
     return {

@@ -170,7 +170,7 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
                          error_feedback=False, attack="",
                          client_ledger=False, reputation=False,
                          fused_apply=False, cohort_layout="spatial",
-                         example_dp=False):
+                         example_dp=False, hierarchy=False):
     """Engine-level mirror of config.validate()'s pairing rejections,
     SHARED by both engine factories so a direct ``make_*_round_fn``
     caller can't build an unsound combination that the config layer
@@ -388,6 +388,41 @@ def _check_engine_compat(scaffold, aggregator, compression, clip_delta_norm,
             "reputation weighting requires client_ledger (trust is "
             "computed from the device-resident ledger rows)"
         )
+    if hierarchy:
+        # mirror config.validate()'s server.hierarchy pairing
+        # rejections: the edge tier re-runs this engine per edge over a
+        # sub-population, so any cross-round per-client state or
+        # protocol that assumes ONE flat cohort per round is unsound
+        # when the cohort is split across E independent invocations
+        if scaffold or feddyn:
+            raise ValueError(
+                "hierarchy is incompatible with stateful algorithms "
+                "(the per-client c/h state assumes one flat cohort; "
+                "per-edge invocations would fork the recursion)"
+            )
+        if secagg:
+            raise ValueError(
+                "hierarchy is incompatible with secure aggregation "
+                "(the masking protocol spans one flat cohort; per-edge "
+                "sums would leave edge deltas in the clear anyway)"
+            )
+        if client_dp > 0.0 or example_dp:
+            raise ValueError(
+                "hierarchy is incompatible with DP (the accountant "
+                "assumes one sampling process over the full population, "
+                "not E independent edge cohorts)"
+            )
+        if client_ledger:
+            raise ValueError(
+                "hierarchy is incompatible with client_ledger (the "
+                "device-resident ledger indexes one flat population; "
+                "edge sub-cohorts would alias its rows)"
+            )
+        if error_feedback:
+            raise ValueError(
+                "hierarchy is incompatible with error_feedback (the "
+                "residual memory is keyed by flat cohort slot)"
+            )
 
 
 # fold constant deriving the secure-aggregation mask key from the round
@@ -666,7 +701,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                           rep_strength: float = 6.0,
                           rep_z_gain: float = 1.0,
                           fused_apply: bool = False,
-                          cohort_layout: str = "spatial"):
+                          cohort_layout: str = "spatial",
+                          hierarchy: bool = False):
     """Build the jitted one-program round function.
 
     ``cohort_layout`` (``run.cohort_layout``): ``"spatial"`` is the
@@ -857,7 +893,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                          client_ledger=client_ledger,
                          reputation=reputation, fused_apply=fused_apply,
                          cohort_layout=cohort_layout,
-                         example_dp=bool(getattr(dp_cfg, "enabled", False)))
+                         example_dp=bool(getattr(dp_cfg, "enabled", False)),
+                         hierarchy=hierarchy)
     if fused_apply and not hasattr(server_update, "fused_reduce"):
         # the stacked-path kernel entry lives on the fused server
         # update (make_server_update_fn with cfg.fused_apply) — a

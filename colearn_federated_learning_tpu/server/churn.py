@@ -59,6 +59,8 @@ _TAG_AVAIL = np.uint64(0xC2B2AE3D27D4EB4F)
 _TAG_DROP = np.uint64(0x165667B19E3779F9)
 _TAG_CRASH = np.uint64(0x27D4EB2F165667C5)
 _TAG_FRAC = np.uint64(0x85EBCA6B2C2B2AE3)
+_TAG_ROW = np.uint64(0xD6E8FEB86659FD93)   # client → trace-row mapping
+_TAG_EDGE = np.uint64(0xA0761D6478BD642F)  # per-(round, edge) crash draw
 
 _TWO_PI = 2.0 * np.pi
 
@@ -151,10 +153,111 @@ class ChurnModel:
         return crashed, frac
 
 
+class TraceChurnModel(ChurnModel):
+    """Trace-replay availability (``run.churn.trace``): the diurnal
+    wave is replaced by playback of a FedScale-style per-device on/off
+    trace — a ``.npy`` uint8 bitmap ``[trace_rounds, trace_rows]``
+    opened as a read-only memmap (never materialized; a million-client
+    run touches O(cohort) bytes of it per draw).
+
+    Client ``i`` maps to a STABLE hash-derived trace row (real traces
+    carry fewer devices than the simulated universe, so clients share
+    rows — the standard FedScale replay convention), and round ``r``
+    plays row bit ``[r mod trace_rounds]``. The availability
+    probability is the bit clipped to ``[min_availability, 1]`` — an
+    off-bit client keeps the exploration-floor probability — and the
+    realized bit is the SAME seed-pure hash draw the analytic wave
+    uses, so trace schedules inherit every churn invariant: engine-
+    invariant, resume-replayable with zero checkpoint state, and
+    O(len(ids)) per evaluation. Dropout hazard and crash injection
+    compose unchanged (they are independent hash planes)."""
+
+    def __init__(self, cfg, seed: int):
+        super().__init__(cfg, seed)
+        self.trace_path = str(cfg.trace)
+        # mmap the bitmap: round playback gathers single rows, client
+        # lookups gather single bytes — O(cohort) I/O per draw
+        bitmap = np.load(self.trace_path, mmap_mode="r")
+        if bitmap.ndim != 2 or bitmap.dtype != np.uint8:
+            raise ValueError(
+                f"run.churn.trace {self.trace_path!r}: expected a 2-D "
+                f"uint8 bitmap [trace_rounds, trace_rows], got "
+                f"{bitmap.dtype} {bitmap.shape}"
+            )
+        if bitmap.shape[0] < 1 or bitmap.shape[1] < 1:
+            raise ValueError(
+                f"run.churn.trace {self.trace_path!r}: empty bitmap "
+                f"{bitmap.shape}"
+            )
+        self._bitmap = bitmap
+        self.trace_rounds, self.trace_rows = map(int, bitmap.shape)
+
+    def _rows(self, ids: np.ndarray) -> np.ndarray:
+        """Stable client → trace-row assignment (seed-pure hash)."""
+        ids64 = np.asarray(ids, dtype=np.int64).astype(np.uint64)
+        with np.errstate(over="ignore"):
+            h = _splitmix64(
+                _splitmix64(np.uint64(self.seed & 0xFFFFFFFFFFFFFFFF)
+                            ^ _TAG_ROW) ^ _splitmix64(ids64)
+            )
+        return (h % np.uint64(self.trace_rows)).astype(np.int64)
+
+    def availability_prob(self, round_idx: int, ids) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.int64)
+        row = self._bitmap[int(round_idx) % self.trace_rounds]
+        bit = np.asarray(row[self._rows(ids)], dtype=np.float64)
+        return np.clip(bit, self.floor, 1.0)
+
+
+def build_synthetic_trace(path: str, rounds: int, rows: int, seed: int,
+                          diurnal_period: int = 24,
+                          base_availability: float = 0.7,
+                          diurnal_amplitude: float = 0.5) -> str:
+    """Write a synthetic FedScale-shaped on/off trace bitmap: per-row
+    hash phases on a thresholded diurnal wave, so the replayed traffic
+    has the day/night shape real device traces show. Deterministic in
+    its arguments (hash draws, no RNG state). Returns ``path``."""
+    rounds, rows = int(rounds), int(rows)
+    if rounds < 1 or rows < 1:
+        raise ValueError(f"trace needs rounds, rows >= 1, got "
+                         f"({rounds}, {rows})")
+    row_ids = np.arange(rows, dtype=np.int64)
+    phase = _hash01(seed, _TAG_PHASE, 0, row_ids)
+    bitmap = np.empty((rounds, rows), dtype=np.uint8)
+    for r in range(rounds):
+        prob = np.clip(
+            base_availability + diurnal_amplitude
+            * np.sin(_TWO_PI * (r / max(1, diurnal_period) + phase)),
+            0.0, 1.0,
+        )
+        bitmap[r] = (_hash01(seed, _TAG_AVAIL, r, row_ids) < prob)
+    np.save(path, bitmap)
+    # np.save appends .npy when absent; report the real filename
+    return path if path.endswith(".npy") else path + ".npy"
+
+
+def edge_crashed(seed: int, round_idx: int, num_edges: int,
+                 rate: float) -> np.ndarray:
+    """[num_edges] bool: which edge aggregators crash this round
+    (``server.hierarchy.edge_dropout_rate``). A module-level pure
+    function — hierarchy fault injection must not require the churn
+    model to be enabled, and every engine/driver path that asks must
+    agree bitwise (same contract as the client-level planes)."""
+    if rate <= 0.0:
+        return np.zeros(num_edges, dtype=bool)
+    u = _hash01(seed, _TAG_EDGE, round_idx,
+                np.arange(num_edges, dtype=np.int64))
+    return u < rate
+
+
 def build_churn_model(cfg) -> "ChurnModel | None":
     """Driver entry: the model iff ``cfg.run.churn.enabled`` (None
     otherwise — churn-off code paths must construct nothing, the
-    bitwise-identity contract)."""
+    bitwise-identity contract). ``run.churn.trace`` selects the
+    trace-replay availability model (construction raises if the trace
+    file is missing or malformed)."""
     if not cfg.run.churn.enabled:
         return None
+    if cfg.run.churn.trace:
+        return TraceChurnModel(cfg.run.churn, cfg.run.seed)
     return ChurnModel(cfg.run.churn, cfg.run.seed)

@@ -196,6 +196,31 @@ class Experiment:
         )
 
         self._churn = build_churn_model(cfg)
+        # Multi-version async lines (server.async_versions): round r
+        # drives line r mod V at line-local version r div V — line 0
+        # keeps the legacy state keys (the V=1 bitwise-identity
+        # contract), lines l >= 1 ride `*_l{l}` keys. Retirement /
+        # re-admission generation accounting lives in state["line_*"].
+        self._versions = cfg.server.async_versions
+        self._staleness_hist: Dict[int, int] = {}
+        self._per_version_absorbed = np.zeros(
+            max(1, cfg.server.async_versions), np.int64
+        )
+        self._version_readmitted = 0
+        self._readmit_warned = False
+        # Two-tier hierarchy (server.hierarchy): E edge aggregators
+        # over deterministic contiguous sub-population blocks. Sync
+        # rounds re-run the ONE compiled engine per edge
+        # (_run_hier_round) and robust-combine edge deltas at the core;
+        # fedbuff groups each popped completion by its edge host-side
+        # (crashed edges' members are excluded, never NaN-poisoning
+        # the core). hierarchy-off constructs nothing (the bitwise-
+        # identity contract).
+        self._hier = cfg.server.hierarchy.num_edges > 0
+        self._hier_stats: Dict[int, Dict[str, int]] = {}
+        self._edge_absorbed = np.zeros(
+            max(1, cfg.server.hierarchy.num_edges), np.int64
+        )
         self.sampler = CohortSampler(
             self.fed.num_clients, cfg.server.cohort_size, seed=cfg.run.seed,
             weights=(
@@ -214,6 +239,28 @@ class Experiment:
                 self._churn.available if self._churn is not None else None
             ),
         )
+        # Hierarchy edge samplers (sync path): one fixed-mode sampler
+        # per edge over its contiguous block [e·N/E, (e+1)·N/E),
+        # id_base-offset so draws come back as GLOBAL client ids (the
+        # churn availability_fn and the engine index the flat
+        # population). Stateless pure-(seed, round) draws — resumes
+        # replay them for free. fedbuff pops its queue instead.
+        self._edge_samplers = []
+        if self._hier and cfg.algorithm not in ("fedbuff", "gossip"):
+            _n = self.fed.num_clients
+            _e_cnt = cfg.server.hierarchy.num_edges
+            for _e in range(_e_cnt):
+                _lo = (_e * _n) // _e_cnt
+                _hi = ((_e + 1) * _n) // _e_cnt
+                self._edge_samplers.append(CohortSampler(
+                    _hi - _lo, cfg.server.cohort_size,
+                    seed=cfg.run.seed + (_e + 1) * 1_000_003,
+                    mode="fixed", id_base=_lo,
+                    availability_fn=(
+                        self._churn.available
+                        if self._churn is not None else None
+                    ),
+                ))
         # Poisson sampling: the realized Binomial(N, q) cohort is padded
         # to a STATIC cap of K + 5σ (so XLA never retraces); overflow
         # raises — an OBSERVABLE abort whose exact binomial-tail
@@ -489,6 +536,11 @@ class Experiment:
                         rep_strength=cfg.server.reputation.strength,
                         rep_z_gain=cfg.server.reputation.z_gain,
                         fused_apply=cfg.server.fused_apply,
+                        hierarchy=self._hier,
+                        # hierarchy re-dispatches the SAME params/opt
+                        # buffers once per edge — donation would delete
+                        # them after the first edge's call
+                        donate=not self._hier,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
@@ -622,7 +674,10 @@ class Experiment:
         # for the drain rules (fuse chunks, bucket rungs, adaptive
         # snapshot boundaries). fedbuff's scheduler pops its queue
         # in-order and is not buffered.
-        self._double_buffer = bool(cfg.run.double_buffer) and not self.fedbuff
+        self._double_buffer = (
+            bool(cfg.run.double_buffer) and not self.fedbuff
+            and not self._hier
+        )
         self._db_stats = {
             "host_prefetched": 0, "placed_prefetched": 0,
             "prefetch_dropped": 0,
@@ -1256,6 +1311,46 @@ class Experiment:
             )
             state["queue_seq"] = np.arange(m, dtype=np.int32)
             state["queue_next_seq"] = m
+            if self._versions > 1:
+                # multi-version lines: line 0 keeps the legacy keys
+                # above; each extra line is an independent FedBuff
+                # instance (own params/opt/history ring/queue) seeded
+                # from its own qrng stream. line_* carries the
+                # retirement generation bookkeeping per line.
+                V = self._versions
+                state["queue_gen"] = np.zeros(m, np.int32)
+                for li in range(1, V):
+                    qrng_l = np.random.default_rng((seed, 8191, li))
+                    state[f"params_l{li}"] = params
+                    state[f"server_opt_state_l{li}"] = (
+                        self.server_opt_init(params)
+                    )
+                    state[f"history_l{li}"] = jax.tree.map(
+                        lambda p: jnp.broadcast_to(
+                            p[None], (window,) + p.shape
+                        ), params,
+                    )
+                    state[f"queue_clients_l{li}"] = qrng_l.choice(
+                        self.fed.num_clients, size=m,
+                        replace=m > self.fed.num_clients,
+                    ).astype(np.int32)
+                    state[f"queue_versions_l{li}"] = np.zeros(m, np.int32)
+                    state[f"queue_finish_l{li}"] = self._client_durations(
+                        state[f"queue_clients_l{li}"], qrng_l
+                    )
+                    state[f"queue_seq_l{li}"] = np.arange(m, dtype=np.int32)
+                    state[f"queue_next_seq_l{li}"] = m
+                    state[f"queue_gen_l{li}"] = np.zeros(m, np.int32)
+                state["line_gen"] = np.zeros(V, np.int32)
+                state["line_birth"] = np.zeros(V, np.int32)
+                state["line_absorbed"] = np.zeros(V, np.int64)
+        if self._hier:
+            # per-edge reputation trust for the core tier (EMA over
+            # edge liveness; consumed when core_aggregator="reputation",
+            # always maintained as a health signal). Checkpointed.
+            state["edge_trust"] = np.ones(
+                self.cfg.server.hierarchy.num_edges, np.float32
+            )
         return state
 
     def _client_durations(self, clients: np.ndarray, rng) -> np.ndarray:
@@ -1371,14 +1466,49 @@ class Experiment:
                 state["replicas"],
             )
         if self.fedbuff:
-            if self._data_sharding is not None:
-                state["history"] = self._put_data(state["history"])
-            for key in ("queue_clients", "queue_versions", "queue_finish",
-                        "queue_seq"):
-                a = state[key]
-                if not (isinstance(a, np.ndarray) and a.flags.writeable):
-                    state[key] = np.array(a, dtype=np.int32, copy=True)
-            state["queue_next_seq"] = int(state["queue_next_seq"])
+            V = self._versions
+            qkeys = ["queue_clients", "queue_versions", "queue_finish",
+                     "queue_seq"] + (["queue_gen"] if V > 1 else [])
+            for li in range(V):
+                sfx = "" if li == 0 else f"_l{li}"
+                if self._data_sharding is not None:
+                    state["history" + sfx] = self._put_data(
+                        state["history" + sfx]
+                    )
+                    if sfx:
+                        # extra lines' trees place like line 0's (which
+                        # went through the generic params placement at
+                        # the top of this method)
+                        state["params" + sfx] = self._put_data(
+                            state["params" + sfx]
+                        )
+                        state["server_opt_state" + sfx] = self._put_data(
+                            state["server_opt_state" + sfx]
+                        )
+                for key in qkeys:
+                    a = state[key + sfx]
+                    if not (isinstance(a, np.ndarray) and a.flags.writeable):
+                        state[key + sfx] = np.array(
+                            a, dtype=np.int32, copy=True
+                        )
+                state["queue_next_seq" + sfx] = int(
+                    state["queue_next_seq" + sfx]
+                )
+            if V > 1:
+                for key, dt in (("line_gen", np.int32),
+                                ("line_birth", np.int32),
+                                ("line_absorbed", np.int64)):
+                    a = state[key]
+                    if not (isinstance(a, np.ndarray) and a.flags.writeable
+                            and a.dtype == dt):
+                        state[key] = np.array(a, dtype=dt, copy=True)
+        if self._hier:
+            a = state["edge_trust"]
+            if not (isinstance(a, np.ndarray) and a.flags.writeable
+                    and a.dtype == np.float32):
+                state["edge_trust"] = np.array(
+                    a, dtype=np.float32, copy=True
+                )
         return state
 
     # ---- heterogeneity-aware round shapes (run.shape_buckets) --------
@@ -1945,8 +2075,41 @@ class Experiment:
         s_max = cfg.server.async_max_staleness
         window = 2 * s_max + 1
         k = cfg.server.cohort_size
-        version = round_idx
+        # multi-version lines (server.async_versions): round r drives
+        # line r mod V at LINE-LOCAL version r div V — each line is an
+        # independent FedBuff instance (own params/history/queue) whose
+        # queue arithmetic runs in line-local steps. V=1 degenerates to
+        # line 0 at version == round_idx, bitwise the single-version
+        # plane (sfx == "" selects the legacy state keys).
+        V = self._versions
+        line = round_idx % V
+        version = round_idx // V
+        sfx = "" if line == 0 else f"_l{line}"
+        q_clients = state["queue_clients" + sfx]
+        q_versions = state["queue_versions" + sfx]
+        q_finish = state["queue_finish" + sfx]
+        q_seq = state["queue_seq" + sfx]
         host_rng = np.random.default_rng((cfg.run.seed, 6073, round_idx))
+        # version retirement (server.async_retire_*): at the line's
+        # turn, a generation that aged past async_retire_rounds or
+        # absorbed async_retire_updates RETIRES — the line's params
+        # continue as the successor generation, and in-flight work
+        # against the retired generation re-admits below at the oldest
+        # live version with decayed weight (strict_versions rejects).
+        gen = 0
+        q_gen = None
+        if V > 1:
+            q_gen = state["queue_gen" + sfx]
+            gen = int(state["line_gen"][line])
+            age = version - int(state["line_birth"][line])
+            rr = cfg.server.async_retire_rounds
+            ru = cfg.server.async_retire_updates
+            if ((rr > 0 and age >= rr) or
+                    (ru > 0 and int(state["line_absorbed"][line]) >= ru)):
+                gen += 1
+                state["line_gen"][line] = gen
+                state["line_birth"][line] = version
+                state["line_absorbed"][line] = 0
         if (self._snapshot_refresh and round_idx > 0
                 and round_idx % self._ledger_cfg.log_every == 0):
             # streaming-sketch refresh from the per-insert ledger, at
@@ -1970,17 +2133,11 @@ class Experiment:
                 # shape pop and realize as churn dropouts (weight 0)
                 # in _apply_failures, their slots re-queued fresh.
                 offline = (
-                    ~self._churn.available(
-                        round_idx, state["queue_clients"]
-                    )
+                    ~self._churn.available(round_idx, q_clients)
                 ).astype(np.int32)
-                order = np.lexsort((
-                    state["queue_seq"], state["queue_finish"], offline,
-                ))
+                order = np.lexsort((q_seq, q_finish, offline))
             else:
-                order = np.lexsort(
-                    (state["queue_seq"], state["queue_finish"])
-                )
+                order = np.lexsort((q_seq, q_finish))
             pick = order[:k]
             cap = cfg.server.async_backlog_cap
             if cap > 0:
@@ -1989,9 +2146,7 @@ class Experiment:
                 # the cap is shed per policy — the client re-enters as
                 # a fresh arrival at the current version, its
                 # in-flight work discarded (counted)
-                done = np.flatnonzero(
-                    state["queue_finish"] <= round_idx
-                )
+                done = np.flatnonzero(q_finish <= version)
                 waiting = np.setdiff1d(done, pick, assume_unique=False)
                 excess = len(waiting) - cap
                 if excess > 0:
@@ -1999,31 +2154,37 @@ class Experiment:
                         # shed the stalest waiters (oldest start
                         # version first; ties by arrival order)
                         shed_order = np.lexsort((
-                            state["queue_seq"][waiting],
-                            state["queue_versions"][waiting],
+                            q_seq[waiting], q_versions[waiting],
                         ))
                         shed = waiting[shed_order[:excess]]
                         n_bp_drop = excess
                     else:  # reject_newest: FIFO admission
                         shed_order = np.lexsort((
-                            -state["queue_seq"][waiting],
-                            -state["queue_versions"][waiting],
+                            -q_seq[waiting], -q_versions[waiting],
                         ))
                         shed = waiting[shed_order[:excess]]
                         n_bp_rej = excess
-                    state["queue_versions"][shed] = version + 1
-                    state["queue_finish"][shed] = (
-                        round_idx + 1 + self._client_durations(
-                            state["queue_clients"][shed], host_rng
+                    q_versions[shed] = version + 1
+                    q_finish[shed] = (
+                        version + 1 + self._client_durations(
+                            q_clients[shed], host_rng
                         )
                     ).astype(np.int32)
-                    nxt_shed = state["queue_next_seq"]
-                    state["queue_seq"][shed] = np.arange(
+                    nxt_shed = state["queue_next_seq" + sfx]
+                    q_seq[shed] = np.arange(
                         nxt_shed, nxt_shed + excess, dtype=np.int32
                     )
-                    state["queue_next_seq"] = nxt_shed + excess
-            cohort = state["queue_clients"][pick].copy()
-            staleness = version - state["queue_versions"][pick]
+                    state["queue_next_seq" + sfx] = nxt_shed + excess
+                    if q_gen is not None:
+                        # shed clients re-enter as fresh arrivals of
+                        # the CURRENT generation
+                        q_gen[shed] = gen
+            cohort = q_clients[pick].copy()
+            staleness = version - q_versions[pick]
+            late = np.zeros(k, dtype=bool)
+            if q_gen is not None:
+                late = q_gen[pick] < gen
+            n_readmit = int(late.sum())
         if not (staleness >= 0).all():
             # a negative staleness is a scheduler bug, never a churn
             # outcome — must survive python -O
@@ -2045,9 +2206,38 @@ class Experiment:
         # arithmetic on the clamped version — the true start was
         # overwritten), while its weight decays at the TRUE staleness
         eff_versions = np.maximum(
-            state["queue_versions"][pick], version - 2 * s_max
+            q_versions[pick], version - 2 * s_max
         )
         slots = (eff_versions % window).astype(np.int32)
+        if n_readmit:
+            # late completions against a retired generation: hard
+            # reject under run.strict_versions, otherwise re-admit at
+            # the oldest live version (the slot clamp above already
+            # covers an aged-out start) with decayed weight below
+            if cfg.run.strict_versions:
+                raise RuntimeError(
+                    f"fedbuff line {line}: {n_readmit} completion(s) "
+                    f"arrived against a retired generation "
+                    f"(queue gen < line gen {gen}) and "
+                    f"run.strict_versions=true rejects re-admission"
+                )
+            if not self._readmit_warned:
+                self._readmit_warned = True
+                self.logger.log({
+                    "event": "warning",
+                    "warning": "version_readmitted",
+                    "round": int(round_idx),
+                    "detail": (
+                        f"fedbuff line {line}: completion(s) against a "
+                        f"retired generation re-admitted at the oldest "
+                        f"live version with weight decayed by "
+                        f"async_readmit_decay="
+                        f"{cfg.server.async_readmit_decay} per retired "
+                        f"generation; counted as version_readmitted "
+                        f"(warn-once; set run.strict_versions=true to "
+                        f"make this an error)"
+                    ),
+                })
         if n_clamped and not self._staleness_warned:
             self._staleness_warned = True
             self.logger.log({
@@ -2064,13 +2254,26 @@ class Experiment:
                     f"run.strict_staleness=true to make this an error)"
                 ),
             })
+        stale_f = staleness.astype(np.float64)
         self._async_stats[round_idx] = {
             "mean": float(staleness.mean()),
             "max": int(staleness.max()),
+            "p50": float(np.percentile(stale_f, 50)),
+            "p90": float(np.percentile(stale_f, 90)),
             "clamped": n_clamped,
             "bp_dropped": n_bp_drop,
             "bp_rejected": n_bp_rej,
         }
+        if V > 1:
+            self._async_stats[round_idx]["version"] = line
+            self._async_stats[round_idx]["readmitted"] = n_readmit
+        # pooled run-level staleness distribution (run_summary / bench
+        # extras): a bounded value→count histogram, never per-update
+        for v_, c_ in zip(*np.unique(staleness, return_counts=True)):
+            self._staleness_hist[int(v_)] = (
+                self._staleness_hist.get(int(v_), 0) + int(c_)
+            )
+        self._version_readmitted += n_readmit
 
         with self.tracer.span("round.host_inputs"):
             idx, mask, n_ex = make_round_indices(
@@ -2090,12 +2293,67 @@ class Experiment:
             base_w * (1.0 + staleness.astype(np.float32))
             ** -cfg.server.async_staleness_exponent
         )
-        self._async_absorbed += int((n_ex > 0).sum())
+        if n_readmit:
+            # re-admission decay: once per retired generation gap, on
+            # top of the true-staleness decay above
+            agg_w = agg_w * np.where(
+                late,
+                np.float32(cfg.server.async_readmit_decay)
+                ** (gen - q_gen[pick]).astype(np.float32),
+                np.float32(1.0),
+            ).astype(np.float32)
+        absorbed_mask = n_ex > 0
+        n_edges_crashed = n_edge_excluded = 0
+        if self._hier:
+            # async two-tier grouping: each popped completion belongs
+            # to the edge covering its contiguous id block. A crashed
+            # edge's completions are EXCLUDED (weight 0, counted) — a
+            # dead tier degrades the step, never NaN-poisons the core.
+            # core_aggregator="reputation" folds the edge-liveness
+            # trust EMA into its members' admission weights.
+            from colearn_federated_learning_tpu.server.churn import (
+                edge_crashed,
+            )
+
+            E = cfg.server.hierarchy.num_edges
+            edge_ids = (
+                np.asarray(cohort, np.int64) * E // self.fed.num_clients
+            )
+            e_crashed = edge_crashed(
+                cfg.run.seed, round_idx, E,
+                cfg.server.hierarchy.edge_dropout_rate,
+            )
+            n_edges_crashed = int(e_crashed.sum())
+            excl = e_crashed[edge_ids]
+            n_edge_excluded = int((excl & absorbed_mask).sum())
+            agg_w = agg_w * (~excl).astype(np.float32)
+            absorbed_mask = absorbed_mask & ~excl
+            trust = state["edge_trust"]
+            if cfg.server.hierarchy.core_aggregator == "reputation":
+                agg_w = agg_w * trust[edge_ids].astype(np.float32)
+            d = cfg.server.hierarchy.core_trust_decay
+            trust *= np.float32(1.0 - d)
+            trust += np.float32(d) * (~e_crashed).astype(np.float32)
+            np.add.at(self._edge_absorbed, edge_ids[absorbed_mask], 1)
+            if n_edges_crashed:
+                self._async_stats[round_idx]["edge_crashed"] = (
+                    n_edges_crashed
+                )
+                self._async_stats[round_idx]["edge_excluded"] = (
+                    n_edge_excluded
+                )
+        n_absorbed = int(absorbed_mask.sum())
+        self._async_absorbed += n_absorbed
+        self._per_version_absorbed[line] += n_absorbed
+        if V > 1:
+            state["line_absorbed"][line] += n_absorbed
         if self._population is not None:
             self._population.observe_async(
-                round_idx, staleness, absorbed=int((n_ex > 0).sum()),
+                round_idx, staleness, absorbed=n_absorbed,
                 clamped=n_clamped, bp_dropped=n_bp_drop,
-                bp_rejected=n_bp_rej,
+                bp_rejected=n_bp_rej, readmitted=n_readmit,
+                edge_crashed=n_edges_crashed,
+                version=line if V > 1 else None,
             )
 
         if self._stream:
@@ -2115,7 +2373,8 @@ class Experiment:
         put_c = lambda a: self._put(jnp.asarray(a), self._client_sharding)  # noqa: E731
         rng = jax.random.fold_in(state["rng_key"], round_idx)
         common = (
-            state["history"], state["server_opt_state"], train_x, train_y,
+            state["history" + sfx], state["server_opt_state" + sfx],
+            train_x, train_y,
             put_c(idx), put_c(mask), put_c(agg_w.astype(np.float32)),
             put_c(n_ex), put_c(slots),
         )
@@ -2176,28 +2435,31 @@ class Experiment:
             self._population.observe_cohort(
                 round_idx, cohort, n_ex, arrival_draws,
             )
-        state["queue_clients"][pick] = arrivals
-        state["queue_versions"][pick] = version + 1
-        state["queue_finish"][pick] = (
-            round_idx + 1
-            + self._client_durations(state["queue_clients"][pick], host_rng)
+        q_clients[pick] = arrivals
+        q_versions[pick] = version + 1
+        q_finish[pick] = (
+            version + 1
+            + self._client_durations(q_clients[pick], host_rng)
         ).astype(np.int32)
-        nxt = state["queue_next_seq"]
-        state["queue_seq"][pick] = np.arange(nxt, nxt + k, dtype=np.int32)
+        nxt = state["queue_next_seq" + sfx]
+        q_seq[pick] = np.arange(nxt, nxt + k, dtype=np.int32)
+        if q_gen is not None:
+            q_gen[pick] = gen
 
-        new_state = {
-            "history": history,
-            "params": params,
-            "server_opt_state": opt_state,
+        # pass-through: every other line's state (and any host-side
+        # sampler/ledger keys) rides unchanged; only this line's tree,
+        # ring, and queue-counter keys are replaced. V=1 produces
+        # exactly the legacy key set (the bitwise-identity contract).
+        new_state = dict(state)
+        new_state.pop("_metrics", None)
+        new_state.update({
+            "history" + sfx: history,
+            "params" + sfx: params,
+            "server_opt_state" + sfx: opt_state,
             "round": round_idx + 1,
-            "rng_key": state["rng_key"],
-            "queue_clients": state["queue_clients"],
-            "queue_versions": state["queue_versions"],
-            "queue_finish": state["queue_finish"],
-            "queue_seq": state["queue_seq"],
-            "queue_next_seq": nxt + k,
+            "queue_next_seq" + sfx: nxt + k,
             "_metrics": metrics,
-        }
+        })
         if self._ledger_on:
             new_state["ledger"] = ledger
         return new_state
@@ -2252,6 +2514,183 @@ class Experiment:
             self._unfused_cache = self._make_engine(1)
         return self._unfused_cache
 
+    def _run_hier_round(self, state: Dict[str, Any],
+                        round_idx: int) -> Dict[str, Any]:
+        """One two-tier synchronous round (``server.hierarchy``): E
+        edge aggregators each run the EXISTING compiled round program
+        over a cohort sampled from their contiguous sub-population
+        block (device → edge tier, with ``server.aggregator`` as the
+        edge-tier defense, e.g. krum), then the core combines the E
+        edge DELTAS per ``hierarchy.core_aggregator`` — example-
+        weighted mean, reputation-weighted mean over the edge-liveness
+        trust EMA, or a robust reduce (median/trimmed_mean/krum with
+        the core knobs). Edge-dropout fault injection
+        (``edge_dropout_rate``, seed-pure per (round, edge)) skips the
+        crashed edge's dispatch entirely: its delta is EXCLUDED from
+        the core combine and counted — a dead tier degrades the round,
+        it never NaN-poisons the aggregate (an all-crashed round is an
+        exact no-op). The engine is reused recursively: ONE compile
+        serves all E invocations, and validate() already restricted
+        the pairing surface to what that reuse keeps sound."""
+        from colearn_federated_learning_tpu.parallel.round_engine import (
+            RoundMetrics,
+        )
+        from colearn_federated_learning_tpu.server.aggregation import (
+            robust_reduce,
+        )
+        from colearn_federated_learning_tpu.server.churn import edge_crashed
+
+        cfg = self.cfg
+        hier = cfg.server.hierarchy
+        E = hier.num_edges
+        crashed = edge_crashed(
+            cfg.run.seed, round_idx, E, hier.edge_dropout_rate
+        )
+        n_crashed = int(crashed.sum())
+        params0 = state["params"]
+        base_rng = jax.random.fold_in(state["rng_key"], round_idx)
+        zero_delta = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params0
+        )
+        deltas = []
+        participation = np.zeros(E, np.float32)
+        edge_examples = np.zeros(E, np.float64)
+        edge_metrics = []
+        opt_state_new = None
+        fail_acc: Dict[str, int] = {}
+        byz_total = 0
+        all_cohorts, all_nex = [], []
+        for e in range(E):
+            cohort = np.asarray(self._edge_samplers[e].sample(round_idx))
+            with self.tracer.span("round.host_inputs"):
+                host_rng = np.random.default_rng(
+                    (cfg.run.seed, 7919, round_idx, e)
+                )
+                if self._spec_inputs:
+                    idx, mask, n_ex = make_round_spec(
+                        self.fed, cohort, self.shape, host_rng
+                    )
+                else:
+                    idx, mask, n_ex = make_round_indices(
+                        self.fed, cohort, self.shape, host_rng
+                    )
+                mask, n_ex = self._apply_failures(
+                    mask, n_ex, len(cohort), host_rng,
+                    round_idx=round_idx, shape=self.shape, cohort=cohort,
+                )
+                # _apply_failures stores per-ROUND counts; merge the
+                # per-edge dicts so the round record sums all tiers
+                for key_, v_ in self._fail_stats.pop(round_idx, {}).items():
+                    fail_acc[key_] = fail_acc.get(key_, 0) + int(v_)
+            all_cohorts.append(cohort)
+            all_nex.append(np.asarray(n_ex))
+            if crashed[e]:
+                # edge crashed mid-round: no dispatch, delta excluded
+                deltas.append(zero_delta)
+                continue
+            akw = {}
+            if self.attack_kind:
+                byz_h = np.isin(cohort, self.compromised)
+                byz_total += int(byz_h.sum())
+                if self._attack_upload:
+                    byz = jnp.asarray(byz_h.astype(np.float32))
+                    if self._client_sharding is not None:
+                        byz = self._put(byz, self._client_sharding)
+                    akw["byz"] = byz
+            idx_p, mask_p, n_ex_p, train_x, train_y = (
+                self._place_round_inputs(idx, mask, n_ex, None)
+            )
+            rng_e = jax.random.fold_in(base_rng, e)
+            with self.tracer.span("round.dispatch"):
+                params_e, opt_e, metrics_e = self.round_fn(
+                    params0, state["server_opt_state"], train_x, train_y,
+                    idx_p, mask_p, n_ex_p, rng_e, **akw,
+                )
+            deltas.append(jax.tree.map(
+                lambda a, b: (a - b).astype(jnp.float32),
+                params_e, params0,
+            ))
+            participation[e] = 1.0
+            edge_examples[e] = float(np.asarray(n_ex).sum())
+            edge_metrics.append(metrics_e)
+            if opt_state_new is None:
+                # optimizer="mean" (validate-enforced): every edge's
+                # returned opt state is identical — take the first
+                opt_state_new = opt_e
+        if fail_acc:
+            self._fail_stats[round_idx] = fail_acc
+        if self.attack_kind:
+            self._attack_stats[round_idx] = byz_total
+        n_alive = int(participation.sum())
+        self._edge_absorbed += participation.astype(np.int64)
+        if n_alive == 0:
+            # every edge crashed: the round is an exact no-op (params
+            # and opt state carry; the zero-example metrics record it)
+            new_params = params0
+            opt_state_new = state["server_opt_state"]
+            metrics = RoundMetrics(jnp.float32(0.0), jnp.float32(0.0))
+        else:
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *deltas)
+            if hier.core_aggregator in ("median", "trimmed_mean", "krum"):
+                mean_delta = robust_reduce(
+                    stacked, jnp.asarray(participation),
+                    hier.core_aggregator,
+                    trim_ratio=hier.core_trim_ratio,
+                    byzantine_f=hier.core_krum_byzantine,
+                )
+            else:
+                w = edge_examples * participation.astype(np.float64)
+                if hier.core_aggregator == "reputation":
+                    w = w * state["edge_trust"].astype(np.float64)
+                ws = w.sum()
+                w = (w / (ws if ws > 0 else 1.0)).astype(np.float32)
+                wj = jnp.asarray(w)
+                mean_delta = jax.tree.map(
+                    lambda s: jnp.tensordot(wj, s, axes=(0, 0)), stacked
+                )
+            new_params = jax.tree.map(
+                lambda p, d: (p + d.astype(p.dtype)).astype(p.dtype),
+                params0, mean_delta,
+            )
+            losses = jnp.stack([m.train_loss for m in edge_metrics])
+            exs = jnp.stack(
+                [jnp.asarray(m.examples, jnp.float32) for m in edge_metrics]
+            )
+            tot = exs.sum()
+            metrics = RoundMetrics(
+                (losses * exs).sum() / jnp.maximum(tot, 1.0), tot
+            )
+        # edge-liveness trust EMA (consumed by core "reputation",
+        # always maintained as the tier-health signal)
+        trust = state["edge_trust"]
+        d = hier.core_trust_decay
+        trust *= np.float32(1.0 - d)
+        trust += np.float32(d) * (~crashed).astype(np.float32)
+        union_cohort = np.concatenate(all_cohorts)
+        union_nex = np.concatenate(all_nex)
+        if self._counters_on:
+            stats = self._round_comm(union_cohort, union_nex)
+            # per-tier wire accounting: the edge→core tier moves one
+            # full delta per LIVE edge on top of the device→edge tier
+            # the cohort numbers above describe
+            _, p_bytes = self._param_stats()
+            stats["hier_core_upload_bytes"] = n_alive * p_bytes
+            self._comm_stats[round_idx] = stats
+        if n_crashed:
+            self._hier_stats[round_idx] = {"edge_crashed": n_crashed}
+        if self._population is not None:
+            self._population.observe_cohort(
+                round_idx, union_cohort, union_nex, None,
+            )
+        return {
+            "params": new_params,
+            "server_opt_state": opt_state_new,
+            "round": round_idx + 1,
+            "rng_key": state["rng_key"],
+            "edge_trust": trust,
+            "_metrics": metrics,
+        }
+
     def run_round(self, state: Dict[str, Any], round_idx: int,
                   fuse_override: Optional[int] = None) -> Dict[str, Any]:
         """``fuse_override=1`` forces a single unfused round through the
@@ -2259,6 +2698,8 @@ class Experiment:
         that land off a chunk boundary (see _fit_body)."""
         if self.fedbuff:
             return self._run_async_round(state, round_idx)
+        if self._hier:
+            return self._run_hier_round(state, round_idx)
         if (self._snapshot_refresh and round_idx > 0
                 and round_idx % self._ledger_cfg.log_every == 0):
             # snapshot/sketch refresh BEFORE this round samples: the
@@ -2896,6 +3337,23 @@ class Experiment:
             state["ledger_slots"] = self._pager.slot_clients
             state["ledger_slot_used"] = self._pager.slot_used
 
+    def _staleness_percentiles(self) -> tuple:
+        """(p50, p90, max) over the pooled per-update staleness
+        histogram accumulated across every async round this fit —
+        exact weighted percentiles (the histogram is value → count, so
+        no sample is ever dropped), (0.0, 0.0, 0) before any absorb."""
+        if not self._staleness_hist:
+            return (0.0, 0.0, 0)
+        vals = np.array(sorted(self._staleness_hist), np.int64)
+        cnts = np.array(
+            [self._staleness_hist[int(v)] for v in vals], np.int64
+        )
+        cum = np.cumsum(cnts)
+        total = int(cum[-1])
+        p50 = float(vals[np.searchsorted(cum, 0.5 * total)])
+        p90 = float(vals[np.searchsorted(cum, 0.9 * total)])
+        return (p50, p90, int(vals[-1]))
+
     def fit(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         caller_state = state is not None
         # per-fit accumulators for the end-of-fit `run_summary` record
@@ -2907,6 +3365,7 @@ class Experiment:
             k: 0 for k in ("upload_bytes", "upload_bytes_raw",
                            "download_bytes", "download_bytes_raw",
                            "upload_bytes_full")
+            + (("hier_core_upload_bytes",) if self._hier else ())
         }
         self._total_compiles = 0
         self._total_compile_ms = 0.0
@@ -2914,6 +3373,11 @@ class Experiment:
         self._traffic_totals = {}
         self._async_absorbed = 0
         self._staleness_warned = False
+        self._staleness_hist = {}
+        self._per_version_absorbed[:] = 0
+        self._version_readmitted = 0
+        self._readmit_warned = False
+        self._edge_absorbed[:] = 0
         self._db_stats = {k: 0 for k in self._db_stats}
         # Checkpoint provenance baseline: only checkpoints written BY THIS
         # fit() call may be restored on retry — restoring a stale
@@ -3030,7 +3494,34 @@ class Experiment:
                         "async_staleness_bound": int(
                             2 * self.cfg.server.async_max_staleness
                         ),
+                        # pooled staleness distribution over every
+                        # absorbed update this fit (satellite of the
+                        # hier_async bench: the bound above is the
+                        # ceiling, these are the realized quantiles)
+                        "async_staleness_p50": self._staleness_percentiles()[0],
+                        "async_staleness_p90": self._staleness_percentiles()[1],
+                        "async_staleness_max": self._staleness_percentiles()[2],
                     } if self.fedbuff else {}),
+                    # multi-version plane (server.async_versions > 1):
+                    # per-version absorbed counts + late re-admissions
+                    **({
+                        "async_per_version": {
+                            str(v): int(n) for v, n in enumerate(
+                                self._per_version_absorbed[:self._versions]
+                            )
+                        },
+                    } if self.fedbuff and self._versions > 1 else {}),
+                    # hierarchy plane (server.hierarchy): per-edge
+                    # absorbed updates and the final edge-trust vector
+                    **({
+                        "hier_edges": int(
+                            self.cfg.server.hierarchy.num_edges
+                        ),
+                        "hier_edge_absorbed": {
+                            str(e): int(n)
+                            for e, n in enumerate(self._edge_absorbed)
+                        },
+                    } if self._hier else {}),
                     # population totals (run.obs.population): lifetime
                     # coverage / participation / pager hit rate / store
                     # bytes — `colearn summarize` renders these
@@ -3207,6 +3698,38 @@ class Experiment:
                 "min_availability": float(cch.min_availability),
                 "dropout_hazard": float(cch.dropout_hazard),
                 "crash_rate": float(cch.crash_rate),
+                # trace replay (run.churn.trace): the availability
+                # schedule came from a recorded on/off bitmap, not the
+                # analytic diurnal model — record its shape so a
+                # resume/replay can be checked against the same file
+                **({
+                    "trace": str(cch.trace),
+                    "trace_rounds": int(self._churn.trace_rounds),
+                    "trace_rows": int(self._churn.trace_rows),
+                } if cch.trace else {}),
+            })
+        if start_round == 0 and self._hier:
+            # hierarchy provenance: the two-tier topology and the core
+            # defense every per-tier number in this log ran under
+            hch = cfg.server.hierarchy
+            self.logger.log({
+                "event": "hierarchy",
+                "num_edges": int(hch.num_edges),
+                "core_aggregator": str(hch.core_aggregator),
+                "edge_aggregator": str(cfg.server.aggregator),
+                "edge_dropout_rate": float(hch.edge_dropout_rate),
+                "core_trust_decay": float(hch.core_trust_decay),
+            })
+        if start_round == 0 and self.fedbuff and self._versions > 1:
+            # multi-version provenance: concurrent model lines and the
+            # retirement policy their generations age under
+            self.logger.log({
+                "event": "async_versions",
+                "versions": int(self._versions),
+                "retire_rounds": int(cfg.server.async_retire_rounds),
+                "retire_updates": int(cfg.server.async_retire_updates),
+                "readmit_decay": float(cfg.server.async_readmit_decay),
+                "strict_versions": bool(cfg.run.strict_versions),
             })
         if start_round == 0 and self._bucket_ladder is not None:
             # shape-bucket provenance: the ladder every round's grid is
@@ -3357,6 +3880,17 @@ class Experiment:
                     astat = self._async_stats.pop(ridx)
                     record["mean_staleness"] = round(astat["mean"], 3)
                     record["max_staleness"] = int(astat["max"])
+                    record["staleness_p50"] = round(astat["p50"], 3)
+                    record["staleness_p90"] = round(astat["p90"], 3)
+                    if "version" in astat:
+                        # multi-version plane: which model line this
+                        # round drove, and any late completions folded
+                        # back in from a retired generation
+                        record["async_version"] = int(astat["version"])
+                    if astat.get("readmitted"):
+                        record["version_readmitted"] = int(
+                            astat["readmitted"]
+                        )
                     if astat.get("clamped"):
                         record["staleness_clamped"] = int(astat["clamped"])
                     if astat.get("bp_dropped"):
@@ -3367,9 +3901,25 @@ class Experiment:
                         record["backpressure_rejected"] = int(
                             astat["bp_rejected"]
                         )
+                    if astat.get("edge_crashed"):
+                        record["hier_edge_crashed"] = int(
+                            astat["edge_crashed"]
+                        )
+                    if astat.get("edge_excluded"):
+                        record["hier_edge_excluded"] = int(
+                            astat["edge_excluded"]
+                        )
+                if ridx in self._hier_stats:
+                    hstat = self._hier_stats.pop(ridx)
+                    if hstat.get("edge_crashed"):
+                        record["hier_edge_crashed"] = int(
+                            hstat["edge_crashed"]
+                        )
                 for key in ("staleness_clamped", "backpressure_dropped",
                             "backpressure_rejected", "churn_unavailable",
-                            "churn_dropped", "churn_crashed"):
+                            "churn_dropped", "churn_crashed",
+                            "version_readmitted", "hier_edge_crashed",
+                            "hier_edge_excluded"):
                     if key in record:
                         self._traffic_totals[key] = (
                             self._traffic_totals.get(key, 0)

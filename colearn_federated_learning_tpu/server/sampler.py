@@ -65,11 +65,25 @@ class CohortSampler:
                  staleness_gain: float = 1.0,
                  flag_suppress: float = 4.0,
                  sketch_size: int = 4096,
-                 availability_fn=None):
+                 availability_fn=None,
+                 id_base: int = 0):
         if cohort_size > num_clients:
             raise ValueError(f"cohort {cohort_size} > clients {num_clients}")
         if mode not in ("fixed", "poisson", "adaptive", "streaming"):
             raise ValueError(f"unknown sampler mode {mode!r}")
+        # Sub-population partition offset (server.hierarchy): the
+        # sampler draws over ``num_clients`` LOCAL slots but returns
+        # (and gates availability on) GLOBAL ids ``local + id_base`` —
+        # an edge aggregator's sampler covers exactly its contiguous
+        # block of the universe. 0 = the whole-population sampler,
+        # bitwise-unchanged.
+        if id_base and mode != "fixed":
+            raise ValueError(
+                f"id_base partitioning supports mode='fixed' only "
+                f"(per-edge blocks re-parameterize poisson q / adaptive "
+                f"scores / streaming sketches), not {mode!r}"
+            )
+        self.id_base = int(id_base)
         # Churn gating (run.churn, server/churn.py): a PURE predicate
         # ``(round_idx, ids) -> bool[len(ids)]`` — offline clients are
         # rejected from the draw. Purity is what keeps the schedule a
@@ -393,7 +407,7 @@ class CohortSampler:
             # deterministically — they realize as churn dropouts in
             # the driver's failure path, which is exactly what
             # dispatching to an offline device does.
-            all_ids = np.arange(self.num_clients)
+            all_ids = np.arange(self.num_clients) + self.id_base
             online = all_ids[self.availability_fn(round_idx, all_ids)]
             if len(online) >= self.cohort_size:
                 out = np.sort(rng.choice(
@@ -409,6 +423,8 @@ class CohortSampler:
             rng.choice(self.num_clients, size=self.cohort_size,
                        replace=False, p=self.probs)
         )
+        if self.id_base:
+            out = out + self.id_base
         # dense modes draw all slots from one distribution: "scored"
         # when ledger/static weights shaped it (adaptive past the first
         # snapshot, mode="weighted"), the uniform prior otherwise

@@ -74,6 +74,31 @@ _SECTIONS = [
      "stays in the benign band). Requires run.obs.client_ledger."
      "enabled (and inherits its pairing exclusions). See "
      "docs/DESIGN.md \"Adaptive selection & reputation\"."),
+    ("server.hierarchy", config_mod.HierarchyConfig,
+     "Two-tier (device -> edge -> core) federation "
+     "(server/round_driver.py): num_edges = E > 0 splits the client "
+     "universe into E deterministic contiguous blocks (client i "
+     "belongs to edge i*E // num_clients); each edge aggregator runs "
+     "the EXISTING compiled round program over a cohort drawn from "
+     "its own block (per-edge pure-(seed, round) samplers) with "
+     "server.aggregator as the edge-tier defense (e.g. krum), and "
+     "the core combines the E edge DELTAS per core_aggregator — "
+     "example-weighted mean, reputation (trust-weighted mean over a "
+     "per-edge liveness EMA, decay core_trust_decay), or "
+     "median/trimmed_mean/krum one tier up (robust_reduce over the "
+     "[E] stack; sync path only). edge_dropout_rate injects seed-pure "
+     "per-(round, edge) crashes: a crashed edge's delta is EXCLUDED "
+     "and counted (hier_edge_crashed), never NaN-poisoning the core "
+     "— an all-crashed round is an exact no-op. Under "
+     "algorithm=fedbuff the hierarchy rides the async scheduler "
+     "instead: popped completions group by their client's edge, "
+     "crashed edges' completions are excluded that server step, and "
+     "edge trust multiplies the staleness-decayed weights. Per-tier "
+     "wire accounting (hier_core_upload_bytes) and per-edge absorbed "
+     "counts land in round records and run_summary. num_edges=0 "
+     "constructs nothing and is bitwise-identical to the flat plane "
+     "(test-pinned). See docs/DESIGN.md \"Hierarchical & "
+     "multi-version federation\"."),
     ("server.adaptive", config_mod.AdaptiveSamplerConfig,
      "Scoring knobs for server.sampling=\"adaptive\": Oort-style "
      "utility-aware cohort selection from the ledger's periodic "

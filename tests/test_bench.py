@@ -282,6 +282,52 @@ def test_bench_report_explicit_phase_budget_overrides_best(tmp_path):
                for v in report["violations"])
 
 
+def _hier_async_entry(tmp_path, ups, max_stale, n=8):
+    entry = {"n": n, "rc": 0, "parsed": {
+        "value": ups, "vs_baseline": 1.0, "config": "hier_async_1m",
+        "extra": {"staleness_bound": 4,
+                  "max_realized_staleness": max_stale,
+                  "hier_edges": 4, "async_versions": 2,
+                  "per_version_absorbed": {"0": 50, "1": 50},
+                  "per_edge_absorbed": {"0": 25, "1": 25,
+                                        "2": 25, "3": 25}},
+    }}
+    with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as f:
+        json.dump(entry, f)
+
+
+def test_bench_report_hier_async_gates_on_both_axes(tmp_path):
+    """The hier_async entries gate TWICE (ISSUE 16 satellite): the
+    shared updates/sec floor AND the realized-staleness ceiling — a
+    hierarchy that buys throughput by letting staleness run away
+    still fails the report, naming the axis that tripped."""
+    from colearn_federated_learning_tpu.obs import roofline
+
+    budgets = {"async_updates_per_sec_min": 50.0,
+               "hier_async_staleness_bound": 4}
+    _seed_history(tmp_path)
+    # healthy: above the floor, within the bound
+    _hier_async_entry(tmp_path, ups=500.0, max_stale=3)
+    entries = roofline.load_bench_history(str(tmp_path))
+    assert entries[-1]["async_throughput"][0]["per_edge_absorbed"]
+    assert roofline.bench_report(entries, budgets)["violations"] == []
+    # staleness runs away while throughput stays green: still a failure
+    _hier_async_entry(tmp_path, ups=500.0, max_stale=7)
+    entries = roofline.load_bench_history(str(tmp_path))
+    vios = roofline.bench_report(entries, budgets)["violations"]
+    assert any("staleness 7" in v and "hier_async_1m" in v for v in vios)
+    assert not any("updates/sec" in v for v in vios)
+    # throughput collapse trips the shared floor too
+    _hier_async_entry(tmp_path, ups=5.0, max_stale=3)
+    entries = roofline.load_bench_history(str(tmp_path))
+    vios = roofline.bench_report(entries, budgets)["violations"]
+    assert any("updates/sec" in v for v in vios)
+
+
+def test_hier_async_bench_entry_defined():
+    assert bench._HIER_ASYNC_SCALE == {"hier_async_1m": 1_000_000}
+
+
 # ---------------------------------------------------------------------------
 # weak-scaling axis (r12): weak_scale_* entries + the bench-report line
 # ---------------------------------------------------------------------------

@@ -585,6 +585,207 @@ def test_watch_and_population_render_async_churn_panels(tmp_path, _store_dir):
 
 
 # ---------------------------------------------------------------------------
+# trace-replay availability (run.churn.trace)
+# ---------------------------------------------------------------------------
+
+
+def _trace_cfg_obj(trace_path, **kw):
+    c = _Cfg(**kw)
+    c.trace = str(trace_path)
+    return c
+
+
+def test_trace_model_replays_the_bitmap_pure_and_wrapping(tmp_path):
+    from colearn_federated_learning_tpu.server.churn import (
+        TraceChurnModel,
+        build_synthetic_trace,
+    )
+
+    path = build_synthetic_trace(
+        str(tmp_path / "trace"), rounds=16, rows=64, seed=3,
+        diurnal_period=8,
+    )
+    # deterministic in its arguments: a rebuild is byte-identical
+    path2 = build_synthetic_trace(
+        str(tmp_path / "trace2"), rounds=16, rows=64, seed=3,
+        diurnal_period=8,
+    )
+    np.testing.assert_array_equal(np.load(path), np.load(path2))
+    m = TraceChurnModel(_trace_cfg_obj(path), seed=7)
+    assert (m.trace_rounds, m.trace_rows) == (16, 64)
+    ids = np.arange(256)  # more clients than rows: rows are shared
+    for r in (0, 5, 11):
+        np.testing.assert_array_equal(
+            m.available(r, ids), m.available(r, ids)
+        )
+        p = m.availability_prob(r, ids)
+        # the prob IS the bit clipped to the exploration floor
+        assert set(np.round(p, 3)) <= {0.05, 1.0}, set(p)
+        # playback wraps mod trace_rounds
+        np.testing.assert_array_equal(p, m.availability_prob(r + 16, ids))
+    # the row mapping is stable (pure in (seed, id)) but seed-sensitive
+    m2 = TraceChurnModel(_trace_cfg_obj(path), seed=8)
+    assert not np.array_equal(
+        m.availability_prob(0, ids), m2.availability_prob(0, ids)
+    )
+    # dropout/crash hazards compose unchanged (independent hash planes)
+    assert abs(m.dropped(3, np.arange(20_000)).mean() - 0.1) < 0.02
+
+
+def test_trace_model_rejects_missing_or_malformed_bitmaps(tmp_path):
+    from colearn_federated_learning_tpu.server.churn import TraceChurnModel
+
+    with pytest.raises(FileNotFoundError):
+        TraceChurnModel(_trace_cfg_obj(tmp_path / "nope.npy"), seed=0)
+    bad = tmp_path / "bad.npy"
+    np.save(bad, np.zeros((4, 4), np.float32))
+    with pytest.raises(ValueError, match="uint8"):
+        TraceChurnModel(_trace_cfg_obj(bad), seed=0)
+    flat = tmp_path / "flat.npy"
+    np.save(flat, np.zeros(16, np.uint8))
+    with pytest.raises(ValueError, match="2-D"):
+        TraceChurnModel(_trace_cfg_obj(flat), seed=0)
+
+
+def _trace_overrides(tmp_path):
+    from colearn_federated_learning_tpu.server.churn import (
+        build_synthetic_trace,
+    )
+
+    path = build_synthetic_trace(
+        str(tmp_path / "avail_trace"), rounds=12, rows=32, seed=0,
+        diurnal_period=6,
+    )
+    return {
+        "run.churn.enabled": True,
+        "run.churn.trace": path,
+        "run.churn.dropout_hazard": 0.1,
+        "run.churn.crash_rate": 0.2,
+    }
+
+
+def test_trace_schedule_is_engine_invariant(tmp_path):
+    """Trace playback inherits the churn purity contract verbatim: the
+    realized cohorts are bitwise-equal across engines."""
+    over = _trace_overrides(tmp_path)
+    cohorts = {}
+    for engine in ("sharded", "sequential"):
+        cfg = _sync_cfg(tmp_path / engine, rounds=4,
+                        **dict(over, **{"run.engine": engine}))
+        exp = Experiment(cfg, echo=False)
+        from colearn_federated_learning_tpu.server.churn import (
+            TraceChurnModel,
+        )
+
+        assert isinstance(exp._churn, TraceChurnModel)
+        cohorts[engine] = [
+            np.asarray(exp.sampler.sample(r)) for r in range(8)
+        ]
+    for a, b in zip(cohorts["sharded"], cohorts["sequential"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_trace_resume_replays_bitwise_and_logs_provenance(tmp_path):
+    """Nothing trace-related rides the checkpoint: a resumed run
+    re-derives every draw from (seed, round, id) + the mmapped bitmap;
+    the churn event pins the trace provenance."""
+    over = _trace_overrides(tmp_path)
+
+    def run(path, rounds, resume=False):
+        cfg = _sync_cfg(path, rounds=rounds, **over)
+        cfg.server.checkpoint_every = 2
+        cfg.run.resume = resume
+        return cfg, Experiment(cfg, echo=False).fit()
+
+    cfg_s, straight = run(tmp_path / "straight", 6)
+    run(tmp_path / "resumed", 4)
+    _, resumed = run(tmp_path / "resumed", 6, resume=True)
+    assert int(resumed["round"]) == 6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        straight["params"], resumed["params"],
+    )
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / "straight" / f"{cfg_s.name}.metrics.jsonl")
+    ]
+    churn_ev = [r for r in records if r.get("event") == "churn"]
+    assert len(churn_ev) == 1
+    assert churn_ev[0]["trace"].endswith("avail_trace.npy")
+    assert churn_ev[0]["trace_rounds"] == 12
+    assert churn_ev[0]["trace_rows"] == 32
+
+
+# ---------------------------------------------------------------------------
+# diurnal-trough edge case: every draw stays bounded and deterministic
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_trough_draw_is_bounded_and_deterministic():
+    """A full-depth trough (every client offline) must terminate the
+    streaming rejection loop within its try budget and complete the
+    cohort with the deterministic smallest-id backstop — bounded
+    iterations, never an infinite loop."""
+    from colearn_federated_learning_tpu.server.sampler import (
+        _MAX_DRAW_TRIES_PER_SLOT,
+        CohortSampler,
+    )
+
+    calls = {"n": 0}
+
+    def all_offline(round_idx, ids):
+        calls["n"] += len(ids)
+        return np.zeros(len(np.atleast_1d(ids)), bool)
+
+    k = 4
+    s = CohortSampler(1000, k, seed=0, mode="streaming",
+                      availability_fn=all_offline)
+    out = s.sample(0)
+    np.testing.assert_array_equal(out, np.arange(k))  # smallest ids
+    assert calls["n"] <= _MAX_DRAW_TRIES_PER_SLOT * k  # bounded tries
+    draws = s.take_draw_stats(0)
+    assert draws["backstop"] == k
+    assert draws["offline"] > 0
+    # deterministic: the same round draws the same backstop cohort
+    np.testing.assert_array_equal(out, s.sample(0))
+
+
+def test_uniform_trough_fills_smallest_offline_ids():
+    """The gated uniform draw under a partial trough: every online
+    client participates and the smallest offline ids fill the rest —
+    no rejection loop at all."""
+    from colearn_federated_learning_tpu.server.sampler import CohortSampler
+
+    online_set = {7, 11}
+
+    def avail(round_idx, ids):
+        return np.isin(np.atleast_1d(ids), list(online_set))
+
+    s = CohortSampler(16, 4, seed=0, mode="fixed", availability_fn=avail)
+    np.testing.assert_array_equal(s.sample(0), np.array([0, 1, 7, 11]))
+    # full trough: deterministic smallest ids
+    online_set.clear()
+    np.testing.assert_array_equal(s.sample(1), np.arange(4))
+
+
+def test_trough_floor_keeps_probability_at_min_availability():
+    """base_availability AT the floor with a full-depth diurnal wave:
+    the clip keeps every probability exactly at min_availability in
+    the trough — the exploration floor never closes."""
+    m = ChurnModel(
+        _Cfg(base_availability=0.05, diurnal_amplitude=1.0,
+             diurnal_period=8),
+        seed=0,
+    )
+    ids = np.arange(512)
+    probs = np.stack([m.availability_prob(r, ids) for r in range(8)])
+    assert probs.min() >= 0.05 - 1e-12
+    assert (np.isclose(probs, 0.05)).any()  # the trough actually bites
+
+
+# ---------------------------------------------------------------------------
 # capability-matrix flips + analyzer coverage
 # ---------------------------------------------------------------------------
 

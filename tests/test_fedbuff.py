@@ -204,6 +204,182 @@ def test_fedbuff_config_validation():
         cfg.validate()
 
 
+# ---------------------------------------------------------------------------
+# multi-version lines (server.async_versions): interleave, V=1 identity,
+# retirement/re-admission, and the bitwise admission-schedule resume
+# ---------------------------------------------------------------------------
+
+
+def _mv_cfg(tmp_path, rounds=24, versions=2, **over):
+    cfg = _fedbuff_cfg(tmp_path, rounds=rounds)
+    cfg.server.async_versions = versions
+    cfg.run.metrics_flush_every = 2
+    for k, v in over.items():
+        cfg.apply_overrides({k: v})
+    return cfg.validate()
+
+
+def test_multiversion_lines_interleave_and_split_absorption(tmp_path):
+    """V=2: round r drives line r mod 2 — two independent FedBuff
+    instances on one device footprint, each absorbing its own stream
+    with line-local staleness accounting."""
+    cfg = _mv_cfg(tmp_path, rounds=24)
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert int(state["round"]) == 24
+    # line 1 rides suffixed copies of every scheduler key; line 0 keeps
+    # the legacy names
+    for key in ("params_l1", "history_l1", "queue_clients_l1",
+                "queue_versions_l1", "queue_finish_l1", "queue_seq_l1",
+                "queue_gen_l1", "line_gen"):
+        assert key in state, key
+    # each line took 12 of the 24 server steps: m initial arrivals plus
+    # 12 pops of K re-queued slots, per line
+    m, k = 4 * 2, 4
+    assert state["queue_next_seq"] == m + 12 * k
+    assert state["queue_next_seq_l1"] == m + 12 * k
+    import json
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    ev = [r for r in records if r.get("event") == "async_versions"]
+    assert len(ev) == 1 and ev[0]["versions"] == 2
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    per_v = summary["async_per_version"]
+    assert per_v["0"] > 0 and per_v["1"] > 0, per_v
+    assert per_v["0"] + per_v["1"] == summary["async_updates_absorbed"]
+    # exact pooled percentiles rode along
+    assert summary["async_staleness_max"] <= 2 * cfg.server.async_max_staleness
+    assert summary["async_staleness_p50"] <= summary["async_staleness_p90"]
+    # no retirement configured: generations never advanced
+    np.testing.assert_array_equal(state["line_gen"], np.zeros(2, np.int32))
+    assert exp.evaluate(state["params"])["eval_acc"] > 0.5
+
+
+def test_multiversion_v1_is_the_legacy_plane(tmp_path):
+    """V=1 must be bitwise the flat FedBuff plane: no line keys, no
+    generation bookkeeping, no per-version summary split."""
+    cfg = _fedbuff_cfg(tmp_path, rounds=4)
+    cfg.run.out_dir = str(tmp_path)
+    cfg.validate()
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert exp._versions == 1
+    assert not any(
+        k.endswith("_l1") or k.startswith("line_") or k == "queue_gen"
+        for k in state
+    ), sorted(state)
+    import json
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    assert "async_per_version" not in summary
+    assert not [r for r in records if r.get("event") == "async_versions"]
+
+
+def test_version_retirement_readmits_decayed_and_counts(tmp_path):
+    """A line retires its generation every async_retire_rounds
+    line-local versions; in-flight completions against the dead
+    generation re-admit at decayed weight — counted per round and in
+    the totals, warned exactly once, never dropped."""
+    cfg = _mv_cfg(tmp_path, rounds=24,
+                  **{"server.async_retire_rounds": 3,
+                     "server.async_readmit_decay": 0.5})
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert int(state["round"]) == 24
+    assert (np.asarray(state["line_gen"]) > 0).all()
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree.leaves(jax.device_get(state["params"]))
+    )
+    import json
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    assert summary.get("version_readmitted", 0) > 0, summary
+    warns = [r for r in records if r.get("event") == "warning"
+             and r.get("warning") == "version_readmitted"]
+    assert len(warns) == 1, warns  # warn-once
+    rounds = [r for r in records if "version_readmitted" in r
+              and "event" not in r]
+    assert sum(r["version_readmitted"] for r in rounds) \
+        == summary["version_readmitted"]
+
+
+def test_strict_versions_restores_the_hard_reject(tmp_path):
+    cfg = _mv_cfg(tmp_path, rounds=24,
+                  **{"server.async_retire_rounds": 3,
+                     "run.strict_versions": True})
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(RuntimeError, match="retired generation"):
+        exp.fit()
+
+
+def test_multiversion_resume_mid_buffer_is_bitwise(tmp_path):
+    """Satellite pin: a V=2 run resumed from a mid-buffer checkpoint
+    replays the straight run's admission schedule BITWISE — every
+    queue array (both lines), the generation bookkeeping, and the
+    arrival sequence counters."""
+    def run(path, rounds, resume=False):
+        cfg = _mv_cfg(path, rounds=rounds,
+                      **{"server.async_retire_rounds": 3})
+        cfg.server.checkpoint_every = 1
+        cfg.run.resume = resume
+        return Experiment(cfg, echo=False).fit()
+
+    straight = run(tmp_path / "straight", 8)
+    run(tmp_path / "resumed", 4)
+    resumed = run(tmp_path / "resumed", 8, resume=True)
+    assert int(resumed["round"]) == 8
+    for key in ("queue_clients", "queue_versions", "queue_finish",
+                "queue_seq", "queue_gen", "queue_clients_l1",
+                "queue_versions_l1", "queue_finish_l1", "queue_seq_l1",
+                "queue_gen_l1", "line_gen", "line_birth",
+                "line_absorbed"):
+        np.testing.assert_array_equal(
+            np.asarray(straight[key]), np.asarray(resumed[key]), err_msg=key
+        )
+    assert straight["queue_next_seq"] == resumed["queue_next_seq"]
+    assert straight["queue_next_seq_l1"] == resumed["queue_next_seq_l1"]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        straight["params"], resumed["params"],
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        straight["params_l1"], resumed["params_l1"],
+    )
+
+
+def test_multiversion_config_validation():
+    cfg = _fedbuff_cfg("unused")
+    cfg.server.async_versions = 0
+    with pytest.raises(ValueError, match="async_versions"):
+        cfg.validate()
+    cfg = _fedbuff_cfg("unused")
+    cfg.server.async_retire_rounds = 2  # retirement needs V >= 2
+    with pytest.raises(ValueError, match="async_versions >= 2"):
+        cfg.validate()
+    cfg = _fedbuff_cfg("unused")
+    cfg.run.strict_versions = True
+    with pytest.raises(ValueError, match="strict_versions"):
+        cfg.validate()
+    cfg = get_named_config("mnist_fedavg_2")  # sync: versions rejected
+    cfg.server.async_versions = 2
+    with pytest.raises(ValueError, match="fedbuff"):
+        cfg.validate()
+
+
 def test_fedbuff_durations_correlate_with_shard_size(tmp_path):
     """VERDICT r2 weak-#4: the async workload model must couple client
     train durations (and hence realized staleness) to data heterogeneity

@@ -1,0 +1,287 @@
+"""Two-tier hierarchical federation (server.hierarchy): seed-pure edge
+crashes, pairing rejections, hierarchy-off bitwise identity, the sync
+e2e over robust cores, engine invariance, edge-crash exclusion (a
+crashed edge never NaN-poisons the core — including the all-crashed
+no-op corner), the fedbuff edge grouping, and the provenance/summary
+plumbing."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.server.churn import edge_crashed
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _hier_cfg(tmp_path, name="hier", rounds=4, edges=2, **over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.name = name
+    cfg.data.num_clients = 16
+    cfg.server.cohort_size = 4
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    cfg.client.batch_size = 8
+    cfg.data.max_examples_per_client = 32
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.metrics_flush_every = 1
+    cfg.server.hierarchy.num_edges = edges
+    for k, v in over.items():
+        cfg.apply_overrides({k: v})
+    return cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# unit: edge fault injection is a seed-pure module function
+# ---------------------------------------------------------------------------
+
+
+def test_edge_crashed_is_pure_and_rate_faithful():
+    np.testing.assert_array_equal(
+        edge_crashed(7, 3, 8, 0.5), edge_crashed(7, 3, 8, 0.5)
+    )
+    assert not edge_crashed(0, 0, 8, 0.0).any()
+    assert edge_crashed(0, 0, 8, 1.0).all()
+    # rate-faithful over many rounds, and seed-sensitive
+    draws = np.stack([edge_crashed(1, r, 16, 0.3) for r in range(500)])
+    assert abs(draws.mean() - 0.3) < 0.03
+    assert not all(
+        np.array_equal(edge_crashed(1, r, 16, 0.3),
+                       edge_crashed(2, r, 16, 0.3))
+        for r in range(8)
+    )
+
+
+# ---------------------------------------------------------------------------
+# config pairing rejections
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("overrides,match", [
+    ({"algorithm": "gossip", "server.sampling": "uniform"}, "gossip"),
+    ({"algorithm": "scaffold"}, "stateful"),
+    ({"server.error_feedback": True,
+      "server.compression": "topk"}, "error_feedback"),
+    ({"server.secure_aggregation": True}, "secure_aggregation"),
+    ({"run.obs.client_ledger.enabled": True}, "client_ledger"),
+    ({"server.optimizer": "adam"}, "optimizer"),
+    ({"server.hierarchy.num_edges": 8}, "full cohort"),
+    ({"server.hierarchy.core_aggregator": "nonsense"}, "core_aggregator"),
+    ({"server.hierarchy.edge_dropout_rate": 1.5}, "edge_dropout_rate"),
+])
+def test_hierarchy_pairing_rejections(tmp_path, overrides, match):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.num_clients = 16
+    cfg.server.cohort_size = 4
+    cfg.server.hierarchy.num_edges = 2
+    for k, v in overrides.items():
+        cfg.apply_overrides({k: v})
+    with pytest.raises(ValueError, match=match):
+        cfg.validate()
+
+
+def test_fedbuff_hierarchy_rejects_order_statistic_cores():
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.algorithm = "fedbuff"
+    cfg.data.num_clients = 16
+    cfg.server.cohort_size = 4
+    cfg.server.hierarchy.num_edges = 2
+    cfg.server.hierarchy.core_aggregator = "median"
+    with pytest.raises(ValueError, match="delta stack"):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# hierarchy-off bitwise identity (stray core knobs construct nothing)
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchy_off_is_bitwise_identical_with_stray_knobs(tmp_path):
+    """num_edges=0 must construct nothing: a run with every core knob
+    set (but zero edges) is bitwise the plain run — params AND the
+    state-tree key set (no edge_trust, no edge samplers)."""
+    plain = Experiment(_hier_cfg(tmp_path / "a", edges=0), echo=False)
+    s_plain = plain.fit()
+    stray = Experiment(_hier_cfg(
+        tmp_path / "b", edges=0,
+        **{"server.hierarchy.core_aggregator": "median",
+           "server.hierarchy.edge_dropout_rate": 0.9,
+           "server.hierarchy.core_trust_decay": 0.9,
+           "server.hierarchy.core_trim_ratio": 0.3},
+    ), echo=False)
+    s_stray = stray.fit()
+    assert not stray._hier and not stray._edge_samplers
+    assert "edge_trust" not in s_plain and "edge_trust" not in s_stray
+    assert set(s_plain) == set(s_stray)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        s_plain["params"], s_stray["params"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# the sync two-tier round: e2e, engine invariance, robust cores
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchy_sync_e2e_converges_and_logs_provenance(tmp_path):
+    cfg = _hier_cfg(tmp_path, rounds=15, edges=2)
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert int(state["round"]) == 15
+    assert exp.evaluate(state["params"])["eval_acc"] > 0.6
+    # no faults injected: trust stays exactly 1 and both edges absorbed
+    np.testing.assert_array_equal(
+        np.asarray(state["edge_trust"]), np.ones(2, np.float32)
+    )
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    hier_ev = [r for r in records if r.get("event") == "hierarchy"]
+    assert len(hier_ev) == 1
+    assert hier_ev[0]["num_edges"] == 2
+    assert hier_ev[0]["core_aggregator"] == "mean"
+    assert hier_ev[0]["edge_aggregator"] == cfg.server.aggregator
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    assert summary["hier_edges"] == 2
+    absorbed = summary["hier_edge_absorbed"]
+    assert all(absorbed[str(e)] > 0 for e in range(2)), absorbed
+    # per-tier wire accounting: the edge->core hop is counted on top
+    # of the device->edge bytes
+    assert summary.get("hier_core_upload_bytes", 0) > 0
+
+
+def test_hierarchy_schedule_is_engine_invariant(tmp_path):
+    """sharded vs sequential under identical topology: the per-edge
+    cohort schedule is host code (pure in (seed, round, edge)), and
+    params agree at engine tolerance."""
+    runs = {}
+    for engine in ("sharded", "sequential"):
+        cfg = _hier_cfg(tmp_path / engine, rounds=3,
+                        **{"run.engine": engine})
+        exp = Experiment(cfg, echo=False)
+        state = exp._place_state(exp.init_state())
+        cohorts = []
+        for r in range(3):
+            cohorts.append(np.concatenate(
+                [np.asarray(s.sample(r)) for s in exp._edge_samplers]
+            ))
+            state = exp.run_round(state, r)
+            state.pop("_metrics")
+        runs[engine] = (state, cohorts)
+    (s_sh, c_sh), (s_sq, c_sq) = runs["sharded"], runs["sequential"]
+    for a, b in zip(c_sh, c_sq):
+        np.testing.assert_array_equal(a, b)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        s_sh["params"], s_sq["params"],
+    )
+
+
+@pytest.mark.parametrize("core", ["median", "trimmed_mean", "krum"])
+def test_hierarchy_robust_cores_stay_finite(tmp_path, core):
+    cfg = _hier_cfg(tmp_path / core, rounds=3, edges=4,
+                    **{"server.hierarchy.core_aggregator": core})
+    exp = Experiment(cfg, echo=False)
+    state = exp._place_state(exp.init_state())
+    for r in range(3):
+        state = exp.run_round(state, r)
+        state.pop("_metrics")
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree.leaves(jax.device_get(state["params"]))
+    )
+
+
+# ---------------------------------------------------------------------------
+# edge faults: excluded and counted, never poisoning the core
+# ---------------------------------------------------------------------------
+
+
+def test_edge_crash_is_excluded_counted_and_decays_trust(tmp_path):
+    cfg = _hier_cfg(
+        tmp_path, rounds=10, edges=2,
+        **{"server.hierarchy.edge_dropout_rate": 0.4,
+           "server.hierarchy.core_aggregator": "reputation"},
+    )
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    # crashed edges contributed nothing — but never a NaN
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree.leaves(jax.device_get(state["params"]))
+    )
+    trust = np.asarray(state["edge_trust"])
+    assert (trust < 1.0).any(), trust  # crashes actually decayed trust
+    assert (trust > 0.0).all()
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    assert summary.get("hier_edge_crashed", 0) > 0, summary
+    rounds = [r for r in records if "hier_edge_crashed" in r
+              and "event" not in r]
+    assert rounds  # per-round counts flowed too
+
+
+def test_all_edges_crashed_is_an_exact_noop_round(tmp_path):
+    """rate=1.0 crashes every edge every round: the round must carry
+    params bitwise (the degenerate corner of the robust reducers is
+    guarded explicitly, like an empty poisson round)."""
+    cfg = _hier_cfg(tmp_path, rounds=2, edges=2,
+                    **{"server.hierarchy.edge_dropout_rate": 1.0})
+    exp = Experiment(cfg, echo=False)
+    state = exp._place_state(exp.init_state())
+    before = jax.device_get(state["params"])
+    for r in range(2):
+        state = exp.run_round(state, r)
+        state.pop("_metrics")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        before, jax.device_get(state["params"]),
+    )
+    assert exp._hier_stats[0]["edge_crashed"] == 2
+    np.testing.assert_array_equal(exp._edge_absorbed, np.zeros(2))
+
+
+# ---------------------------------------------------------------------------
+# fedbuff under hierarchy: edge-grouped absorption
+# ---------------------------------------------------------------------------
+
+
+def test_fedbuff_hierarchy_groups_absorption_by_edge(tmp_path):
+    cfg = _hier_cfg(
+        tmp_path, rounds=12, edges=2,
+        **{"algorithm": "fedbuff",
+           "server.async_max_staleness": 2,
+           "server.hierarchy.core_aggregator": "reputation",
+           "server.hierarchy.edge_dropout_rate": 0.2},
+    )
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    assert int(state["round"]) == 12
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree.leaves(jax.device_get(state["params"]))
+    )
+    records = [
+        json.loads(line)
+        for line in open(tmp_path / f"{cfg.name}.metrics.jsonl")
+    ]
+    summary = [r for r in records if r.get("event") == "run_summary"][-1]
+    assert summary["hier_edges"] == 2
+    absorbed = summary["hier_edge_absorbed"]
+    assert all(absorbed[str(e)] > 0 for e in range(2)), absorbed
+    assert summary["async_updates_absorbed"] > 0
