@@ -78,6 +78,7 @@ DISPATCH_BOUND_MFU_PCT = 5.0
 from colearn_federated_learning_tpu.obs.roofline import (  # noqa: E402
     PEAK_BF16_FLOPS,
     PEAK_F32_FLOPS,
+    host_exposed_pct as _host_exposed_pct,
     mfu_basis as _roofline_mfu_basis,
 )
 
@@ -381,10 +382,16 @@ def bench_config(name: str):
     if name in DEVICE_MS_BASELINES:
         state, device_ms = _measure_device_ms(exp, state, warmup + timed)
     vs, vs_basis = _gate(name, rounds_per_sec, device_ms, flops_pct)
+    # host-exposed share of the timed wall (obs/roofline.py rule):
+    # the observability-tax number bench-report gates against
+    # host_exposed_pct_max — host spans the device idles through,
+    # over the timed region's wall clock
+    hep = _host_exposed_pct(phase_ms, dt)
     extra = {
         "static_check": _static_check_extra(),
         "vs_baseline_basis": vs_basis,
         "phase_ms": phase_ms,
+        "host_exposed_pct": None if hep is None else round(hep, 2),
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
         "n_chips": exp.n_chips,
         "timed_rounds": timed,

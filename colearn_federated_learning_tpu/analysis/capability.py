@@ -182,6 +182,9 @@ FEATURES: Dict[str, Feature] = {
                            "trace-replay availability (recorded on/off "
                            "bitmap; dir is a validate-level sentinel, "
                            "existence checked at model construction)"),
+    "digest": Feature({"run.obs.digest.enabled": True}, False,
+                      "determinism flight recorder (driver-level digest "
+                      "of fetched state; never reaches the engine)"),
 }
 
 

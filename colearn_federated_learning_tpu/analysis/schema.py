@@ -210,6 +210,18 @@ REGISTRY: Dict[str, RecordSpec] = {
         optional=("draws", "sketch", "pager", "store", "async", "churn"),
         doc="per-window federation health record (obs/population.py)",
     ),
+    "round_digest": RecordSpec(
+        required=("round", "prev_round", "prev", "self", "params",
+                  "params_leaves", "opt", "ledger", "schedule", "wire",
+                  "rng"),
+        doc="determinism flight-recorder chain link (obs/digest.py): "
+            "per-component state digests + the hash-chain self/prev",
+    ),
+    "digest_resume": RecordSpec(
+        required=("round", "ok", "head_round", "head", "detail"),
+        doc="checkpoint digest-head vs log chain verification at resume "
+            "(run.obs.digest.verify_resume)",
+    ),
 }
 
 # modules whose logger.log(...) calls are emit sites (repo-root relative)
@@ -229,6 +241,7 @@ CONSUMER_MODULES = (
     "colearn_federated_learning_tpu/obs/population.py",
     "colearn_federated_learning_tpu/obs/roofline.py",
     "colearn_federated_learning_tpu/obs/ledger.py",
+    "colearn_federated_learning_tpu/obs/digest.py",
 )
 
 

@@ -22,6 +22,13 @@ Entry points with capability parity to the reference's
                                # matrix + mirror drift, seed-purity
                                # lint, JSONL schema cross-check
                                # (exit 1 naming each violation)
+    colearn diff <a> <b>       # determinism bisection: align two runs'
+                               # digest chains and localize the first
+                               # divergent round + component
+                               # (exit 1 on divergence)
+    colearn replay <run> --round r  # re-execute one logged digest
+                               # round from the nearest checkpoint and
+                               # verify the recomputed digest
 
 ``--config`` accepts a registry name or a YAML path; ``--set a.b=v``
 overrides any field. ``fit --resume`` continues from the latest
@@ -78,6 +85,10 @@ def build_parser():
     fit.add_argument("--sanitize", action="store_true",
                      help="NaN debugging + finite-params checks")
     fit.add_argument("--engine", choices=["sharded", "sequential"], default=None)
+    fit.add_argument("--strict-digest", action="store_true",
+                     help="abort when resume-time digest-chain "
+                          "verification fails (run.obs.digest) instead "
+                          "of logging a digest_resume warning")
 
     ev = sub.add_parser("evaluate", help="evaluate latest (or --step) checkpoint")
     _add_common(ev)
@@ -292,6 +303,40 @@ def build_parser():
     br.add_argument("--json", action="store_true",
                     help="emit the report as one JSON object instead of "
                          "the table")
+
+    df = sub.add_parser(
+        "diff",
+        help="determinism bisection (run.obs.digest, obs/digest.py): "
+             "align two runs' round_digest chains, verify each chain's "
+             "hash links, and localize the FIRST divergent round + "
+             "component (params leaf / opt / ledger / schedule / wire "
+             "/ rng) with a per-leaf drill-down — exit 1 on divergence "
+             "or a broken/tampered chain (pure host, no backend init)",
+    )
+    df.add_argument("run_a", metavar="RUN_A",
+                    help="run name (looked up under --out-dir), a run "
+                         "directory, or a .metrics.jsonl path")
+    df.add_argument("run_b", metavar="RUN_B",
+                    help="the run to compare against (same forms)")
+    df.add_argument("--out-dir", default="runs",
+                    help="where <RUN>.metrics.jsonl lives (default: runs)")
+    df.add_argument("--json", action="store_true",
+                    help="emit the diff report as one JSON object "
+                         "instead of the table")
+
+    rp = sub.add_parser(
+        "replay",
+        help="single-round determinism replay (run.obs.digest): "
+             "re-execute exactly one logged digest round from the "
+             "nearest checkpoint at or before its window start and "
+             "verify the recomputed digest against the round_digest "
+             "record, component by component — exit 1 on mismatch",
+    )
+    _add_common(rp)
+    rp.add_argument("--round", type=int, required=True, metavar="R",
+                    dest="replay_round",
+                    help="digest round to replay (a round carrying a "
+                         "round_digest record)")
     return p
 
 
@@ -418,6 +463,38 @@ def main(argv=None):
         # a tripped gate is the whole point: non-zero, naming the phase
         return 1 if report["violations"] else 0
 
+    if args.cmd == "diff":
+        # pure-host digest-chain bisection — two logs in, the first
+        # divergent round + component out (obs/digest.py)
+        from colearn_federated_learning_tpu.obs import digest as obs_digest
+        from colearn_federated_learning_tpu.obs import summary as obs_summary
+
+        sides = []
+        for run in (args.run_a, args.run_b):
+            try:
+                path = obs_summary.resolve_metrics_path(run, args.out_dir)
+            except FileNotFoundError as e:
+                print(f"error: {e.args[0] if e.args else e}",
+                      file=sys.stderr)
+                return 2
+            records = obs_summary.load_records(path)
+            if not any(r.get("event") == "round_digest" for r in records):
+                print(f"error: no round_digest records in {path} "
+                      f"(was the run recorded with "
+                      f"run.obs.digest.enabled=true?)", file=sys.stderr)
+                return 2
+            sides.append((path, records))
+        report = obs_digest.diff_streams(sides[0][1], sides[1][1])
+        if args.json:
+            print(json.dumps(dict(
+                report, path_a=sides[0][0], path_b=sides[1][0],
+            )))
+        else:
+            print(obs_digest.format_diff(report, args.run_a, args.run_b))
+        if report["status"] == "no_overlap":
+            return 2
+        return 0 if report["status"] == "match" else 1
+
     if args.cmd in ("summarize", "clients", "mfu", "watch", "population"):
         # pure-host JSONL aggregation — runs before (and without) any
         # jax backend initialization
@@ -532,6 +609,14 @@ def main(argv=None):
             overrides["run.sanitize"] = True
         if args.engine:
             overrides["run.engine"] = args.engine
+        if args.strict_digest:
+            overrides["run.obs.digest.strict"] = True
+    if args.cmd == "replay":
+        # append-mode logger: the replay reads the run's own JSONL and
+        # must never truncate it; digest-on is purely observational so
+        # forcing it on matches any recorded run's digests
+        overrides["run.resume"] = True
+        overrides["run.obs.digest.enabled"] = True
     try:
         cfg = resolve_config(args.config, overrides)
     except (KeyError, ValueError, FileNotFoundError) as e:
@@ -548,8 +633,19 @@ def main(argv=None):
         # runtime errors below still surface with full tracebacks
         print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
         return 2
+    if args.cmd == "replay":
+        try:
+            report = exp.replay_round(args.replay_round)
+        except (ValueError, FileNotFoundError) as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        print(json.dumps(report))
+        return 0 if report["match"] else 1
     if args.cmd == "fit":
         from colearn_federated_learning_tpu.obs import HealthAbortError
+        from colearn_federated_learning_tpu.obs.digest import (
+            DigestResumeError,
+        )
 
         try:
             state = exp.fit()
@@ -557,6 +653,12 @@ def main(argv=None):
             # the run's health monitor aborted it (run.obs.on_unhealthy);
             # the JSONL holds the structured health events — point there
             print(f"error: run aborted unhealthy: {e}", file=sys.stderr)
+            return 3
+        except DigestResumeError as e:
+            # --strict-digest: the checkpoint's chain head did not
+            # verify against the log — refuse to continue a run whose
+            # history cannot be trusted
+            print(f"error: {e}", file=sys.stderr)
             return 3
         final = {"event": "done", "rounds": int(state["round"]),
                  "wall_time_sec": round(state.get("wall_time", 0.0), 2)}

@@ -758,6 +758,39 @@ class PopulationConfig:
 
 
 @dataclass
+class DigestConfig:
+    """Determinism flight recorder (``run.obs.digest``, obs/digest.py):
+    at each digest boundary the driver computes a canonical,
+    dtype/shape-tagged 64-bit digest over the fetched state — params
+    (per-top-level-leaf + rolled up), server opt state, the
+    ledger/pager hot set, the realized cohort schedule + failure
+    counts and wire-byte counters since the previous boundary, and the
+    RNG inputs — and emits one ``round_digest`` JSONL record chaining
+    ``prev`` → ``self`` (a hash chain: truncated/tampered logs are
+    self-evident). The chain head rides every checkpoint and resume
+    verifies it against the log before training continues. Purely
+    observational: digests are a pure function of fetched state
+    (engine-invariant wherever the engines are bitwise) and digest-on
+    runs are bitwise-identical to digest-off runs on the same seed
+    (test-pinned). ``colearn diff`` bisects two streams to the first
+    divergent round + component; ``colearn replay`` re-executes one
+    logged round and verifies its digest. Off by default (benches
+    never pay the O(P) host fetch)."""
+
+    enabled: bool = False
+    # rounds between digest boundaries; the O(params) host-side fetch
+    # + hash is amortized by this cadence. Under run.fuse_rounds > 1
+    # must be a chunk multiple (boundaries land on chunk ends).
+    every: int = 1
+    # verify the checkpoint's chain head against the log on resume
+    # (warn on mismatch; run.obs.digest.strict aborts instead)
+    verify_resume: bool = True
+    # escalate a failed resume verification from a logged warning to
+    # DigestResumeError (`colearn fit --strict-digest`)
+    strict: bool = False
+
+
+@dataclass
 class ObsConfig:
     """Round-lifecycle telemetry (``obs/``): phase spans, comm/device
     counters, and run-health monitoring — the observability layer every
@@ -821,6 +854,8 @@ class ObsConfig:
     )
     # Federation health observatory — see PopulationConfig.
     population: PopulationConfig = field(default_factory=PopulationConfig)
+    # Determinism flight recorder — see DigestConfig.
+    digest: DigestConfig = field(default_factory=DigestConfig)
 
 
 @dataclass
@@ -2090,6 +2125,21 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown run.obs.phase_cost_flops "
                 f"{obs.phase_cost_flops!r}; expected 'analytic' or 'xla'"
+            )
+        dg = obs.digest
+        if dg.every < 1:
+            raise ValueError(
+                f"run.obs.digest.every must be >= 1, got {dg.every}"
+            )
+        if (dg.enabled and self.run.fuse_rounds > 1
+                and dg.every % self.run.fuse_rounds):
+            # digest boundaries force a metrics flush; the fit loop
+            # steps by chunks, so an unaligned cadence would silently
+            # never fire (same contract as eval_every/checkpoint_every)
+            raise ValueError(
+                f"run.obs.digest.every ({dg.every}) must be a "
+                f"fuse_rounds={self.run.fuse_rounds} multiple (digest "
+                f"boundaries land on chunk ends)"
             )
         pop = obs.population
         if not 4 <= pop.hll_bits <= 18:

@@ -1,0 +1,474 @@
+"""Determinism flight recorder (``run.obs.digest``, obs/digest.py).
+
+The codebase's determinism contracts — bitwise resume replay,
+sharded ≡ sequential engine parity, seed-pure cohort/churn schedules —
+exist as test pins; this module makes them a *monitored* invariant at
+runtime and a *bisectable* event after the fact. At each digest
+boundary the driver computes a cheap, canonical, dtype/shape-tagged
+64-bit digest over the fetched state and emits one ``round_digest``
+JSONL record per boundary:
+
+- ``params`` / ``params_leaves`` — the global params pytree, rolled up
+  and per TOP-LEVEL leaf (module name), so a divergence localizes to
+  the layer that moved;
+- ``opt`` — the server optimizer state;
+- ``ledger`` — the ledger/pager hot set (dense or paged rows, cold
+  spill, slot maps, the active sampler snapshot/sketch);
+- ``schedule`` — the realized cohort schedule + failure counts for
+  every round since the previous boundary;
+- ``wire`` — the per-round analytic wire-byte counters over the same
+  window (empty when ``run.obs.counters`` is off);
+- ``rng`` — the RNG inputs (run seed, round, sampler snapshot round).
+
+Records chain ``prev`` → ``self`` with
+``self = H(prev ‖ round ‖ components)``, so a truncated or tampered
+log is self-evident: every record's ``self`` is recomputable from its
+own fields, and every record's ``prev`` must equal its predecessor's
+``self``. The chain head rides the checkpoint (``digest_head``) and
+resume verifies it against the log before training continues.
+
+Hashing is ``hashlib.blake2b(digest_size=8)`` — a stdlib, C-speed
+64-bit digest in the xxhash cost class (BLAKE2's keyed/tree features
+unused; we need speed + stability, not cryptographic strength).
+Arrays are tagged with ``dtype.str`` + shape before their contiguous
+bytes, so an f32/bf16 cast or a reshape can never collide. Digests
+are a pure function of the fetched state: engine-invariant wherever
+the engines are bitwise (everything but wall-clock), and digest-on
+runs are bitwise-identical to digest-off runs on the same seed
+(test-pinned) — the recorder only ever reads.
+
+Pure-host consumers (no backend init):
+
+- ``colearn diff <run_a> <run_b>`` aligns two digest streams,
+  verifies each chain, and localizes the FIRST divergent round +
+  component (params leaf / opt / ledger / schedule / wire / rng) with
+  a per-leaf drill-down; exit 1 on divergence or a broken chain.
+- ``colearn replay <run> --round r`` re-executes exactly one round
+  from the nearest checkpoint ≤ the record's window start and
+  verifies the recomputed digest against the logged one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# 64-bit hex digests; the genesis "prev" of a fresh chain
+HEX_WIDTH = 16
+GENESIS = "0" * HEX_WIDTH
+
+# component priority when NAMING a divergence (the ISSUE's order); all
+# diverged components are still listed in the report
+COMPONENT_ORDER = ("params", "opt", "ledger", "schedule", "wire", "rng")
+
+# state keys that make up the ``ledger`` component: the ledger/pager
+# hot set plus the sampler's active snapshot/sketch (everything the
+# selection path reads that rides the checkpoint)
+LEDGER_STATE_KEYS = (
+    "ledger", "ledger_cold", "ledger_slots", "ledger_slot_used",
+    "ledger_snapshot", "ledger_snapshot_round",
+    "ledger_sketch_ids", "ledger_sketch_stats",
+)
+
+
+class DigestResumeError(RuntimeError):
+    """Resume-time chain-head verification failed under
+    ``run.obs.digest.strict`` (the ``colearn fit --strict-digest``
+    escalation of the logged ``digest_resume`` warning)."""
+
+
+def _h(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=8).hexdigest()
+
+
+def _canon(obj: Any) -> Any:
+    """Canonicalize plain data for hashing: numpy scalars → python,
+    numpy arrays → nested lists, dict keys → str."""
+    if isinstance(obj, dict):
+        return {str(k): _canon(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_canon(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    return obj
+
+
+def json_digest(obj: Any) -> str:
+    """Canonical-JSON digest of plain (non-array) data."""
+    payload = json.dumps(_canon(obj), sort_keys=True, separators=(",", ":"))
+    return _h(payload.encode("utf-8"))
+
+
+def array_digest(a: Any) -> str:
+    """Dtype/shape-tagged digest of one array: ``dtype.str`` + shape
+    prefix the contiguous bytes, so a cast or reshape never collides
+    with the original. Python scalars hash through a 0-d array of
+    their canonical dtype."""
+    arr = np.asarray(a)
+    tag = f"{arr.dtype.str}:{arr.shape}:".encode("ascii")
+    return _h(tag + np.ascontiguousarray(arr).tobytes())
+
+
+def _flatten_with_path(tree: Any, prefix: str = "") -> List[Tuple[str, Any]]:
+    """Deterministic (path, leaf) flattening: dict keys sorted, tuples/
+    lists positional — stable across pytree registry details (flax
+    FrozenDict vs dict) and python versions."""
+    if isinstance(tree, dict) or hasattr(tree, "items"):
+        out: List[Tuple[str, Any]] = []
+        for k in sorted(tree.keys(), key=str):
+            out.extend(_flatten_with_path(tree[k], f"{prefix}/{k}"))
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for i, v in enumerate(tree):
+            out.extend(_flatten_with_path(v, f"{prefix}/{i}"))
+        return out
+    if hasattr(tree, "_fields"):  # NamedTuple (optax states)
+        out = []
+        for name in tree._fields:
+            out.extend(_flatten_with_path(getattr(tree, name), f"{prefix}/{name}"))
+        return out
+    if tree is None:
+        return []
+    return [(prefix or "/", tree)]
+
+
+def tree_digest(tree: Any) -> str:
+    """Rolled-up digest of a pytree: each leaf's path + array digest
+    folded into one running hash, in canonical path order."""
+    h = hashlib.blake2b(digest_size=8)
+    for path, leaf in _flatten_with_path(tree):
+        h.update(path.encode("utf-8"))
+        h.update(array_digest(leaf).encode("ascii"))
+    return h.hexdigest()
+
+
+def params_digests(params: Any) -> Tuple[str, Dict[str, str]]:
+    """(rollup, {top_level_leaf: digest}) for the params pytree. The
+    per-leaf map keys are the params dict's TOP-LEVEL module names —
+    the drill-down ``colearn diff`` localizes a divergence to."""
+    if isinstance(params, dict) or hasattr(params, "items"):
+        leaves = {
+            str(k): tree_digest(params[k])
+            for k in sorted(params.keys(), key=str)
+        }
+    else:
+        leaves = {"params": tree_digest(params)}
+    h = hashlib.blake2b(digest_size=8)
+    for k in sorted(leaves):
+        h.update(k.encode("utf-8"))
+        h.update(leaves[k].encode("ascii"))
+    return h.hexdigest(), leaves
+
+
+class RoundWindow:
+    """Host-side fold of per-round schedule/wire observations between
+    digest boundaries. The driver observes every round exactly once
+    (at flush, in round order); ``drain`` consumes the window up to a
+    boundary, so the digest stream is invariant to flush cadence and
+    ``run.fuse_rounds``."""
+
+    def __init__(self) -> None:
+        self._rounds: Dict[int, Dict[str, Any]] = {}
+
+    def observe(self, round_1b: int,
+                cohort: Optional[np.ndarray],
+                comm: Optional[Dict[str, Any]],
+                fail: Optional[Dict[str, Any]]) -> None:
+        self._rounds[int(round_1b)] = {
+            "cohort": (
+                None if cohort is None
+                else np.asarray(cohort).astype(np.int64, copy=False)
+            ),
+            "comm": dict(comm) if comm else {},
+            "fail": dict(fail) if fail else {},
+        }
+
+    def drain(self, upto_round: int) -> Tuple[str, str]:
+        """Consume rounds ≤ ``upto_round``; returns the window's
+        (schedule, wire) component digests."""
+        taken = sorted(r for r in self._rounds if r <= upto_round)
+        sched = {}
+        wire = {}
+        for r in taken:
+            entry = self._rounds.pop(r)
+            cohort = entry["cohort"]
+            sched[str(r)] = {
+                "cohort": [] if cohort is None else cohort.tolist(),
+                "fail": entry["fail"],
+            }
+            wire[str(r)] = entry["comm"]
+        return json_digest(sched), json_digest(wire)
+
+
+def state_components(params: Any, opt_state: Any,
+                     ledger_items: Dict[str, Any],
+                     schedule_digest: str, wire_digest: str,
+                     rng_inputs: Dict[str, int]) -> Dict[str, Any]:
+    """The six digest components over already-fetched (host) state."""
+    rollup, leaves = params_digests(params)
+    return {
+        "params": rollup,
+        "params_leaves": leaves,
+        "opt": tree_digest(opt_state),
+        "ledger": tree_digest(
+            {k: ledger_items[k] for k in sorted(ledger_items)}
+        ),
+        "schedule": schedule_digest,
+        "wire": wire_digest,
+        "rng": json_digest(rng_inputs),
+    }
+
+
+def chain_digest(prev: str, round_1b: int,
+                 components: Dict[str, Any]) -> str:
+    """``self = H(prev ‖ round ‖ components)`` — the hash-chain link.
+    Recomputable from a record's own fields, which is what makes
+    tampering self-evident."""
+    payload = {
+        "prev": prev, "round": int(round_1b),
+        **{k: components[k] for k in COMPONENT_ORDER},
+        "params_leaves": components["params_leaves"],
+    }
+    return json_digest(payload)
+
+
+def components_from_record(record: Dict[str, Any]) -> Dict[str, Any]:
+    comp = {k: record.get(k, "") for k in COMPONENT_ORDER}
+    comp["params_leaves"] = record.get("params_leaves", {})
+    return comp
+
+
+# ---- checkpoint head packing ---------------------------------------------
+
+
+def head_pack(self_hex: str, round_1b: int) -> np.ndarray:
+    """Pack the chain head into the ``digest_head`` checkpoint array:
+    uint32 ``[hash_lo, hash_hi, round]`` (all-zero = genesis). Always
+    present in the state template so digest-on/off checkpoints stay
+    template-compatible."""
+    v = int(self_hex, 16) if round_1b else 0
+    return np.array(
+        [v & 0xFFFFFFFF, (v >> 32) & 0xFFFFFFFF, int(round_1b)],
+        dtype=np.uint32,
+    )
+
+
+def head_unpack(head: Any) -> Tuple[str, int]:
+    """(self_hex, round) from a ``digest_head`` array; genesis when the
+    round slot is 0."""
+    arr = np.asarray(head).astype(np.uint64).reshape(-1)
+    round_1b = int(arr[2])
+    if round_1b == 0:
+        return GENESIS, 0
+    v = int(arr[0]) | (int(arr[1]) << 32)
+    return f"{v:016x}", round_1b
+
+
+# ---- pure-host stream consumers ------------------------------------------
+
+
+def digest_records(records: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The run's digest stream: ``round_digest`` records, LAST-wins per
+    round (a crashed-then-retried attempt re-emits boundaries past its
+    restore point; the latest attempt is the run's truth), in round
+    order."""
+    by_round: Dict[int, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("event") == "round_digest":
+            by_round[int(rec["round"])] = rec
+    return [by_round[r] for r in sorted(by_round)]
+
+
+def verify_chain(records: Sequence[Dict[str, Any]]) -> Tuple[bool, List[str]]:
+    """Verify a digest stream's hash chain: every record's ``self``
+    must recompute from its own fields, and every record's ``prev``
+    must equal its predecessor's ``self`` (genesis for the first).
+    A *truncated* log still verifies (a prefix of a valid chain is a
+    valid chain) — truncation is caught by the checkpoint head on
+    resume, or by the longer twin under ``colearn diff``."""
+    stream = digest_records(records)
+    problems: List[str] = []
+    prev_hex, prev_round = GENESIS, 0
+    for rec in stream:
+        r = int(rec["round"])
+        recomputed = chain_digest(
+            rec.get("prev", ""), r, components_from_record(rec)
+        )
+        if recomputed != rec.get("self"):
+            problems.append(
+                f"round {r}: record tampered (self={rec.get('self')!r} "
+                f"but fields recompute to {recomputed!r})"
+            )
+        if rec.get("prev") != prev_hex or int(rec.get("prev_round", -1)) != prev_round:
+            problems.append(
+                f"round {r}: chain broken (prev={rec.get('prev')!r}@"
+                f"{rec.get('prev_round')} but predecessor is "
+                f"{prev_hex!r}@{prev_round})"
+            )
+        prev_hex, prev_round = rec.get("self", ""), r
+    return not problems, problems
+
+
+def resume_head_status(records: Sequence[Dict[str, Any]], head_hex: str,
+                       head_round: int) -> Tuple[bool, str]:
+    """Resume-time verification of the checkpoint's chain head against
+    the (about-to-be-appended-to) log: the log must contain a chain-
+    valid ``round_digest`` record at ``head_round`` whose ``self``
+    matches the head. A truncated log (head record missing) and a
+    tampered log (chain broken at or before the head) both fail."""
+    if head_round == 0:
+        return True, "genesis head (no digests before this checkpoint)"
+    stream = digest_records(records)
+    upto = [r for r in stream if int(r["round"]) <= head_round]
+    ok, problems = verify_chain(upto)
+    if not ok:
+        return False, problems[0]
+    if not upto or int(upto[-1]["round"]) != head_round:
+        last = int(upto[-1]["round"]) if upto else None
+        return False, (
+            f"log truncated: checkpoint head is round {head_round} but "
+            f"the log's last digest at or before it is "
+            f"{'missing' if last is None else f'round {last}'}"
+        )
+    if upto[-1].get("self") != head_hex:
+        return False, (
+            f"head mismatch at round {head_round}: checkpoint carries "
+            f"{head_hex!r} but the log records {upto[-1].get('self')!r}"
+        )
+    return True, f"chain verified through round {head_round}"
+
+
+def _divergent_components(ra: Dict[str, Any],
+                          rb: Dict[str, Any]) -> List[str]:
+    return [
+        c for c in COMPONENT_ORDER if ra.get(c, "") != rb.get(c, "")
+    ]
+
+
+def _leaf_diff(ra: Dict[str, Any], rb: Dict[str, Any]) -> List[str]:
+    la, lb = ra.get("params_leaves", {}), rb.get("params_leaves", {})
+    keys = sorted(set(la) | set(lb))
+    return [k for k in keys if la.get(k) != lb.get(k)]
+
+
+def diff_streams(records_a: Sequence[Dict[str, Any]],
+                 records_b: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Align two digest streams by round and localize the FIRST
+    divergent round + component. Alignment is over the round
+    intersection, so runs at different digest cadences still compare
+    at their common boundaries. Returns a report dict whose ``status``
+    drives the CLI exit code: ``match`` (0), ``diverged`` /
+    ``chain_broken`` (1), ``no_overlap`` (2)."""
+    stream_a, stream_b = digest_records(records_a), digest_records(records_b)
+    ok_a, problems_a = verify_chain(records_a)
+    ok_b, problems_b = verify_chain(records_b)
+    report: Dict[str, Any] = {
+        "rounds_a": len(stream_a), "rounds_b": len(stream_b),
+        "chain_a_ok": ok_a, "chain_b_ok": ok_b,
+        "chain_a_problems": problems_a, "chain_b_problems": problems_b,
+    }
+    if not (ok_a and ok_b):
+        report["status"] = "chain_broken"
+        return report
+    by_a = {int(r["round"]): r for r in stream_a}
+    by_b = {int(r["round"]): r for r in stream_b}
+    common = sorted(set(by_a) & set(by_b))
+    report["common_rounds"] = len(common)
+    if not common:
+        report["status"] = "no_overlap"
+        return report
+    for r in common:
+        ra, rb = by_a[r], by_b[r]
+        if ra.get("self") == rb.get("self"):
+            continue
+        diverged = _divergent_components(ra, rb)
+        # chains verified + selfs differ ⇒ some field differs; an
+        # upstream prev-divergence alone shows as equal components
+        # with different prev links (the earlier round was not common)
+        primary = diverged[0] if diverged else "prev"
+        report.update({
+            "status": "diverged",
+            "first_divergent_round": r,
+            "component": primary,
+            "components": diverged,
+            "params_leaves": (
+                _leaf_diff(ra, rb) if "params" in diverged else []
+            ),
+        })
+        return report
+    # every common boundary matches; differing tails are continuation,
+    # not divergence (a resumed twin that ran further, or an earlier
+    # snapshot of the same run)
+    report["status"] = "match"
+    report["last_common_round"] = common[-1]
+    return report
+
+
+def format_diff(report: Dict[str, Any], name_a: str, name_b: str) -> str:
+    lines = [
+        f"digest diff: {name_a} vs {name_b}",
+        f"  digest rounds: {report.get('rounds_a', 0)} vs "
+        f"{report.get('rounds_b', 0)}"
+        + (f" ({report.get('common_rounds', 0)} common)"
+           if "common_rounds" in report else ""),
+        f"  chain: {'OK' if report.get('chain_a_ok') else 'BROKEN'} vs "
+        f"{'OK' if report.get('chain_b_ok') else 'BROKEN'}",
+    ]
+    for side, key in ((name_a, "chain_a_problems"),
+                      (name_b, "chain_b_problems")):
+        for p in report.get(key, []):
+            lines.append(f"    {side}: {p}")
+    status = report.get("status")
+    if status == "no_overlap":
+        lines.append(
+            "  no common digest rounds — different digest cadences or "
+            "disjoint round ranges; nothing to compare"
+        )
+    elif status == "diverged":
+        r = report["first_divergent_round"]
+        comps = ", ".join(report.get("components", []))
+        lines.append(
+            f"  FIRST DIVERGENCE at round {r}: component "
+            f"{report['component']} (diverged: {comps})"
+        )
+        for leaf in report.get("params_leaves", []):
+            lines.append(f"    params leaf diverged: {leaf}")
+    elif status == "match":
+        lines.append(
+            f"  streams identical through round "
+            f"{report.get('last_common_round')} — no divergence"
+        )
+    return "\n".join(lines)
+
+
+def watch_digest_status(records: Sequence[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """One-line digest-chain status for ``colearn watch``: last digest
+    round, chain OK/broken, and any failed resume verification. None
+    when the run logs no digests (recorder off)."""
+    stream = digest_records(records)
+    resume_fail = None
+    for rec in records:
+        if rec.get("event") == "digest_resume" and not rec.get("ok", True):
+            resume_fail = {
+                "round": int(rec.get("round", 0)),
+                "detail": rec.get("detail", ""),
+            }
+    if not stream and resume_fail is None:
+        return None
+    ok, problems = verify_chain(stream)
+    return {
+        "last_round": int(stream[-1]["round"]) if stream else 0,
+        "chain_ok": ok,
+        "problems": problems[:1],
+        "resume_fail": resume_fail,
+    }

@@ -729,6 +729,15 @@ def watch_snapshot(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     snap["phase_ms"] = {
         n: snap["phase_ms"][n][-32:] for n in top
     }
+    # determinism flight recorder status (run.obs.digest): last
+    # verified digest round, chain OK/broken, and any failed resume
+    # verification — absent key when the run logs no digests
+    from colearn_federated_learning_tpu.obs.digest import (
+        watch_digest_status,
+    )
+    dg = watch_digest_status(records)
+    if dg is not None:
+        snap["digest"] = dg
     return snap
 
 
@@ -766,6 +775,24 @@ def format_watch(snap: Dict[str, Any], path: str = "") -> str:
             if health else "ok"
         )
     )
+    dg = snap.get("digest")
+    if dg:
+        # flight-recorder status line: the chain verdict is recomputed
+        # from the log every frame, so tampering/truncation shows up
+        # live, not only at the next resume
+        line = (
+            f"digest: chain {'OK' if dg.get('chain_ok') else 'BROKEN'}"
+            f" through round {dg.get('last_round', 0)}"
+        )
+        if not dg.get("chain_ok") and dg.get("problems"):
+            line += f"  [{dg['problems'][0]}]"
+        rf = dg.get("resume_fail")
+        if rf:
+            line += (
+                f"  RESUME-VERIFY FAILED @ round {rf.get('round')}"
+                f" ({rf.get('detail', '')})"
+            )
+        lines.append(line)
     asy = snap.get("async")
     if asy or snap.get("staleness_series"):
         # production-traffic panel: arrival rate, staleness

@@ -90,6 +90,25 @@ PHASES = (
 # is host time the device sits idle through.
 _NON_HOST_EXPOSED_SPANS = ("round", "round.dispatch", "compile")
 
+
+def host_exposed_pct(phase_ms: Dict[str, float], wall_s: float) -> Optional[float]:
+    """Fraction of a timed region's wall clock the device sat idle
+    behind host work, as a percentage: the sum of every span that is
+    NOT dispatch/compile (same `_NON_HOST_EXPOSED_SPANS` rule the
+    waterfall uses) over the wall. bench.py stamps this into every
+    result's extras and `bench_report` gates it against
+    ``host_exposed_pct_max`` — the budget that keeps host-side
+    accounting (ledger stats, population windows, digest fetches) from
+    quietly eating the round loop. ``None`` when the wall is
+    unmeasured, so historical entries render n/a, never divide by 0."""
+    if not wall_s or wall_s <= 0:
+        return None
+    host_ms = sum(
+        ms for name, ms in (phase_ms or {}).items()
+        if name not in _NON_HOST_EXPOSED_SPANS
+    )
+    return 100.0 * (host_ms / 1000.0) / float(wall_s)
+
 # Byte-model pass counts (documented constants, not magic numbers):
 # local train touches the params 4× per step (fwd read, bwd read, grad
 # write, local-SGD update write) — activation traffic is workload-
@@ -620,6 +639,7 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
                 "client_updates_per_sec_per_chip"
             ),
             "cohort_layout": extra.get("cohort_layout"),
+            "host_exposed_pct": extra.get("host_exposed_pct"),
             "weak_scale": _tail_weak_scale_records(doc, parsed),
             "async_throughput": _tail_async_records(doc, parsed),
         })
@@ -784,6 +804,18 @@ def bench_report(entries: Sequence[Dict[str, Any]],
                 f"mfu_pct {latest['mfu_pct']:.2f} < budget floor "
                 f"{float(mfu_min):.2f} ({latest['file']})"
             )
+        # host-exposed ceiling: the observability tax budget — fires
+        # only when the entry carries the field (histories predating it
+        # render n/a, never a gate), so BENCH_r01+ keeps passing
+        host_max = budgets.get("host_exposed_pct_max")
+        if (host_max is not None
+                and latest.get("host_exposed_pct") is not None
+                and latest["host_exposed_pct"] > float(host_max)):
+            violations.append(
+                f"host_exposed_pct {latest['host_exposed_pct']:.1f} "
+                f"> budget ceiling {float(host_max):.1f} "
+                f"({latest['file']})"
+            )
         for ph, ms in (latest.get("phase_ms_per_round") or {}).items():
             if ph in explicit:
                 budget = float(explicit[ph])
@@ -890,7 +922,7 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
     lines.append(
         f"{'entry':<18}{'r/s':>8}{'vs_base':>9}{'mfu%':>8}"
         f"{'basis':>11}{'dtype':>10}{'dev ms':>8}"
-        f"{'chips':>7}{'upd/s/chip':>12}"
+        f"{'chips':>7}{'upd/s/chip':>12}{'host%':>7}"
     )
     for e in entries:
         lines.append(
@@ -903,6 +935,7 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
             f"{_na(e.get('device_ms_per_round'), '{:.1f}'):>8}"
             f"{_na(e.get('n_chips')):>7}"
             f"{_na(e.get('updates_per_sec_per_chip'), '{:.1f}'):>12}"
+            f"{_na(e.get('host_exposed_pct'), '{:.1f}'):>7}"
         )
     latest = report.get("latest")
     phases = (latest or {}).get("phase_ms_per_round")

@@ -167,6 +167,31 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
         if population:
             out["population"] = population
+        # async-plane totals (fedbuff runs): absorbed-update throughput
+        # and the REALIZED staleness quantiles next to the configured
+        # bound — the numbers a staleness-bound claim is checked against
+        async_stats = {
+            k: run_sum[k]
+            for k in ("async_updates_absorbed", "async_updates_per_sec",
+                      "async_staleness_bound", "async_staleness_p50",
+                      "async_staleness_p90", "async_staleness_max")
+            if k in run_sum
+        }
+        if async_stats:
+            out["async"] = async_stats
+        # multi-version absorption split (server.async_versions > 1):
+        # which model line each absorbed update landed on
+        if isinstance(run_sum.get("async_per_version"), dict):
+            out["async_per_version"] = {
+                str(k): int(v)
+                for k, v in run_sum["async_per_version"].items()
+            }
+        # two-tier wire accounting: core-link upload bytes ride the
+        # wire-totals line so hierarchy runs read both tiers at once
+        if "hier_core_upload_bytes" in run_sum:
+            out["hier_core_upload_bytes"] = int(
+                run_sum["hier_core_upload_bytes"]
+            )
     if counters:
         out["comm"] = counters
     if dropped or stragglers or byzantine:
@@ -249,13 +274,19 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
     comm = summary.get("comm")
     if comm:
         lines.append("")
-        lines.append(
+        comm_line = (
             "comm: upload "
             f"{_fmt_bytes(comm.get('upload_bytes', 0))} wire / "
             f"{_fmt_bytes(comm.get('upload_bytes_raw', 0))} raw, download "
             f"{_fmt_bytes(comm.get('download_bytes', 0))} wire / "
             f"{_fmt_bytes(comm.get('download_bytes_raw', 0))} raw"
         )
+        if "hier_core_upload_bytes" in summary:
+            comm_line += (
+                ", hier core upload "
+                f"{_fmt_bytes(summary['hier_core_upload_bytes'])}"
+            )
+        lines.append(comm_line)
     paging = summary.get("ledger_paging")
     if paging:
         lines.append(
@@ -284,6 +315,29 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
                 f"store gathered {_fmt_bytes(pop['store_gather_bytes'])}"
             )
         lines.append("population: " + "  ".join(bits))
+    a = summary.get("async")
+    if a:
+        bits = []
+        if "async_updates_absorbed" in a:
+            bits.append(f"{a['async_updates_absorbed']} updates absorbed")
+        if "async_updates_per_sec" in a:
+            bits.append(f"{a['async_updates_per_sec']:.1f}/s")
+        if "async_staleness_p50" in a:
+            bits.append(
+                "staleness p50/p90/max "
+                f"{a.get('async_staleness_p50')}/"
+                f"{a.get('async_staleness_p90')}/"
+                f"{a.get('async_staleness_max')}"
+                + (f" (bound {a['async_staleness_bound']})"
+                   if "async_staleness_bound" in a else "")
+            )
+        lines.append("async: " + "  ".join(bits))
+    apv = summary.get("async_per_version")
+    if apv:
+        split = "  ".join(
+            f"v{k}: {v}" for k, v in sorted(apv.items(), key=lambda i: i[0])
+        )
+        lines.append(f"async per-version absorption: {split}")
     fails = summary.get("failures")
     if fails:
         lines.append(

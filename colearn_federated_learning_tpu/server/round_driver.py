@@ -45,6 +45,7 @@ from colearn_federated_learning_tpu.obs import (
     round_host_input_bytes,
     round_shape_stats,
 )
+from colearn_federated_learning_tpu.obs import digest as digest_mod
 from colearn_federated_learning_tpu.obs.roofline import (
     PEAK_HBM_BYTES_PER_SEC,
     analytic_lora_step_flops,
@@ -822,6 +823,22 @@ class Experiment:
                 recency_capacity=obs.population.recency_capacity,
             )
 
+        # Determinism flight recorder (run.obs.digest, obs/digest.py):
+        # per-boundary canonical state digests chained prev → self in
+        # the JSONL, chain head riding every checkpoint. Read-only over
+        # fetched state — digest-on runs are bitwise-identical to
+        # digest-off (test-pinned); the O(P) fetch+hash is amortized by
+        # `every` and the window fold keeps the schedule/wire
+        # components invariant to flush cadence and fuse_rounds.
+        self._digest_on = bool(obs.digest.enabled)
+        self._digest_every = max(1, int(obs.digest.every))
+        self._digest_cohorts: Dict[int, np.ndarray] = {}
+        self._digest_window = (
+            digest_mod.RoundWindow() if self._digest_on else None
+        )
+        self._digest_prev = digest_mod.GENESIS
+        self._digest_prev_round = 0
+
         # Host-side round-input construction: the C++ threaded pipeline
         # (native/round_pipeline.cpp) builds + prefetches index tensors off
         # the round loop's critical path; NumPy path otherwise.
@@ -1351,6 +1368,13 @@ class Experiment:
             state["edge_trust"] = np.ones(
                 self.cfg.server.hierarchy.num_edges, np.float32
             )
+        # digest-chain head (run.obs.digest): uint32 [hash_lo, hash_hi,
+        # round], all-zero = genesis. ALWAYS in the template — orbax
+        # restore requires template/checkpoint key agreement, and a
+        # digest-off run must be able to restore a digest-on run's
+        # checkpoint (and vice versa). Popped from live state at fit
+        # start (_fit_body) and re-injected at every save site.
+        state["digest_head"] = np.zeros(3, np.uint32)
         return state
 
     def _client_durations(self, clients: np.ndarray, rng) -> np.ndarray:
@@ -1941,6 +1965,13 @@ class Experiment:
                 int(sl_idx.max()) + 1 if sl_idx.size else 0,
             )
         self._maybe_prefetch(round_idx)
+        if self._digest_on:
+            # schedule-component capture (consumed at flush): the
+            # realized cohort ids, poisson pads included — the pad
+            # pattern is part of the deterministic schedule
+            self._digest_cohorts[round_idx] = np.asarray(
+                cohort, np.int64
+            ).copy()
         n_host = np.asarray(n_ex)  # pairwise secagg reads dropout host-side
         if self._counters_on:
             stats = self._round_comm(cohort, n_host)
@@ -2283,6 +2314,12 @@ class Experiment:
                                               round_idx=round_idx,
                                               shape=self.shape,
                                               cohort=cohort)
+        if self._digest_on:
+            # schedule-component capture: the popped completion set IS
+            # the async scheduler's realized schedule for this step
+            self._digest_cohorts[round_idx] = np.asarray(
+                cohort, np.int64
+            ).copy()
         if self._counters_on:
             self._comm_stats[round_idx] = self._round_comm(cohort, n_ex)
         base_w = (
@@ -2668,6 +2705,12 @@ class Experiment:
         trust += np.float32(d) * (~crashed).astype(np.float32)
         union_cohort = np.concatenate(all_cohorts)
         union_nex = np.concatenate(all_nex)
+        if self._digest_on:
+            # schedule-component capture: the per-edge cohorts' union,
+            # in edge order — the two-tier round's realized schedule
+            self._digest_cohorts[round_idx] = np.asarray(
+                union_cohort, np.int64
+            ).copy()
         if self._counters_on:
             stats = self._round_comm(union_cohort, union_nex)
             # per-tier wire accounting: the edge→core tier moves one
@@ -3402,6 +3445,12 @@ class Experiment:
                     # from its own checkpoint re-diverges; retrying
                     # would spend the retry budget hiding the signal
                     raise
+                except digest_mod.DigestResumeError:
+                    # strict digest verification failed: the retry path
+                    # skips verification (its own log tail is expected
+                    # to disagree), so retrying would silently bypass
+                    # the --strict-digest contract
+                    raise
                 except Exception as e:  # noqa: BLE001 — failure recovery (§5)
                     if retries >= self.cfg.run.max_retries:
                         raise
@@ -3586,10 +3635,12 @@ class Experiment:
             # retains the old run's higher-numbered checkpoints — a later
             # resume would then load them under the new (wrong) semantics
             self._check_state_kind()
+        resumed = False
         if state is None:
             if cfg.run.resume and store and store.latest_step() is not None:
                 template = self.init_state()
                 state, step = store.restore(template=template)
+                resumed = True
                 self.logger.log({
                     "event": "resumed", "round": int(state["round"]),
                     # the two host pipelines use different (both
@@ -3599,6 +3650,23 @@ class Experiment:
                 })
             else:
                 state = self.init_state()
+        # The digest-chain head is host bookkeeping, not live round
+        # state (run_round returns fresh dicts that would drop it):
+        # pop it before placement and re-anchor the recorder. A retried
+        # attempt (run.max_retries) re-enters here with the restored
+        # head and NO verification — its own log tail past the restore
+        # point is expected, and the re-run boundaries overwrite it
+        # (last-wins in obs/digest.py's stream view).
+        head = state.pop("digest_head", None)
+        self._digest_prev, self._digest_prev_round = (
+            digest_mod.head_unpack(head) if head is not None
+            else (digest_mod.GENESIS, 0)
+        )
+        self._digest_cohorts.clear()
+        if self._digest_on:
+            self._digest_window = digest_mod.RoundWindow()
+        if self._digest_on and resumed and cfg.run.obs.digest.verify_resume:
+            self._verify_digest_resume(int(state["round"]))
         state = self._place_state(state)
         if self._ledger_on:
             self._ledger_ref = state.get("ledger")
@@ -3834,8 +3902,7 @@ class Experiment:
                     self._write_state_kind()
                     store.save(
                         int(current_state["round"]),
-                        {k: v for k, v in current_state.items()
-                         if k != "wall_time"},
+                        self._state_for_save(current_state),
                         force=True, block=True,
                     )
             flush_obs(int(current_state["round"]))
@@ -3864,8 +3931,20 @@ class Experiment:
                     "train_loss": float(m.train_loss),
                     "examples": float(m.examples),
                 }
-                record.update(self._comm_stats.pop(ridx, ()))
-                record.update(self._fail_stats.pop(ridx, ()))
+                comm = self._comm_stats.pop(ridx, None)
+                fail = self._fail_stats.pop(ridx, None)
+                if comm:
+                    record.update(comm)
+                if fail:
+                    record.update(fail)
+                if self._digest_window is not None:
+                    # fold this round into the digest window (flush
+                    # drains pending in round order, so the fold is
+                    # invariant to flush cadence and fuse_rounds)
+                    self._digest_window.observe(
+                        ridx + 1, self._digest_cohorts.pop(ridx, None),
+                        comm, fail,
+                    )
                 if self.health is not None:
                     ev = self.health.observe_loss(ridx + 1, record["train_loss"])
                     if ev is not None:
@@ -3964,6 +4043,11 @@ class Experiment:
             last_round = pending[-1][0] + 1
             self._rounds_done = max(self._rounds_done, last_round)
             pending.clear()
+            if (self._digest_on and last_round % self._digest_every == 0
+                    and last_round > self._digest_prev_round):
+                # digest boundary: current_state is exactly the state
+                # after last_round (pending held rounds ..last_round-1)
+                self._emit_round_digest(last_round, current_state)
             if (self._ledger_on and self._ledger_cfg.log_every
                     and self._ledger_ref is not None
                     and last_round - self._ledger_logged_round
@@ -4017,6 +4101,10 @@ class Experiment:
                     self._ledger_ref = state.get("ledger")
                 self._carry_host_ledger_state(state)
                 pending.append((r, state.pop("_metrics")))
+                if self._digest_on and (r + 1) % self._digest_every == 0:
+                    # a digest needs the state AT its boundary — flush
+                    # per catch-up round when one is due
+                    flush(state)
             flush(state)
             start_round = aligned
         for r in range(start_round, cfg.server.num_rounds, fuse):
@@ -4062,7 +4150,11 @@ class Experiment:
             r_end = r + fuse  # validate() pins eval/ckpt to chunk ends
             at_eval = cfg.server.eval_every and r_end % cfg.server.eval_every == 0
             at_ckpt = store and cfg.server.checkpoint_every and r_end % cfg.server.checkpoint_every == 0
-            if len(pending) >= flush_every or at_eval or at_ckpt or r_end == cfg.server.num_rounds:
+            # digest boundaries force a flush (the digest reads the
+            # state AT the boundary); ordered before at_ckpt's save so
+            # a checkpoint's head always covers its own round
+            at_digest = self._digest_on and r_end % self._digest_every == 0
+            if len(pending) >= flush_every or at_eval or at_ckpt or at_digest or r_end == cfg.server.num_rounds:
                 flush(state)
             if cfg.run.sanitize:
                 finite = all(
@@ -4081,7 +4173,7 @@ class Experiment:
             if at_ckpt:
                 with self.tracer.span("round.checkpoint"):
                     self._write_state_kind()
-                    store.save(r_end, state)
+                    store.save(r_end, self._state_for_save(state))
                 flush_t0 = time.perf_counter()  # keep save time out of the next window
         flush(state)
         state["wall_time"] = time.perf_counter() - t_start
@@ -4091,10 +4183,213 @@ class Experiment:
                 with self.tracer.span("round.checkpoint"):
                     self._write_state_kind()
                     store.save(int(state["round"]),
-                               {k: v for k, v in state.items() if k != "wall_time"},
+                               self._state_for_save(state),
                                force=True, block=True)
         flush_obs(int(state["round"]))  # tail spans (final save, eval)
         return state
+
+    # ---- determinism flight recorder (run.obs.digest) ----------------
+
+    def _state_for_save(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Checkpoint view of the live state: the wall-time scalar out,
+        the digest-chain head in (template parity with init_state —
+        digest-off runs save the genesis zeros)."""
+        out = {k: v for k, v in state.items() if k != "wall_time"}
+        out["digest_head"] = digest_mod.head_pack(
+            self._digest_prev, self._digest_prev_round
+        )
+        return out
+
+    def _compute_digest(self, last_round: int,
+                        state: Dict[str, Any]) -> Dict[str, Any]:
+        """The six digest components over the state after
+        ``last_round`` + the window since the previous boundary. ONE
+        host fetch (params/opt/ledger together), read-only — the
+        digest-on ≡ digest-off bitwise contract lives here."""
+        ledger_items = {
+            k: state[k]
+            for k in digest_mod.LEDGER_STATE_KEYS if k in state
+        }
+        fetched = jax.device_get({
+            "params": state["params"],
+            "opt": state["server_opt_state"],
+            "ledger": ledger_items,
+        })
+        sched_hex, wire_hex = self._digest_window.drain(last_round)
+        return digest_mod.state_components(
+            fetched["params"], fetched["opt"], fetched["ledger"],
+            sched_hex, wire_hex,
+            {
+                "seed": int(self.cfg.run.seed),
+                "round": int(last_round),
+                "snapshot_round": int(
+                    np.asarray(state.get("ledger_snapshot_round", 0))
+                ),
+            },
+        )
+
+    def _emit_round_digest(self, last_round: int,
+                           state: Dict[str, Any]) -> None:
+        with self.tracer.span("round.digest"):
+            comp = self._compute_digest(last_round, state)
+            self_hex = digest_mod.chain_digest(
+                self._digest_prev, last_round, comp
+            )
+            self.logger.log({
+                "event": "round_digest",
+                "round": int(last_round),
+                "prev_round": int(self._digest_prev_round),
+                "prev": self._digest_prev,
+                "self": self_hex,
+                "params": comp["params"],
+                "params_leaves": comp["params_leaves"],
+                "opt": comp["opt"],
+                "ledger": comp["ledger"],
+                "schedule": comp["schedule"],
+                "wire": comp["wire"],
+                "rng": comp["rng"],
+            })
+            self._digest_prev = self_hex
+            self._digest_prev_round = int(last_round)
+
+    def _load_own_records(self):
+        """This run's already-written JSONL records (resume verify /
+        replay read their own log before training continues)."""
+        path = self.logger.path
+        records = []
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a crashed writer
+        return records
+
+    def _verify_digest_resume(self, start_round: int) -> None:
+        """Resume-time chain verification: the checkpoint's head must
+        match a chain-valid ``round_digest`` record in the log
+        (truncated/tampered logs fail). Logged as a ``digest_resume``
+        event; ``run.obs.digest.strict`` escalates a failure to
+        DigestResumeError before any training happens."""
+        ok, detail = digest_mod.resume_head_status(
+            self._load_own_records(),
+            self._digest_prev, self._digest_prev_round,
+        )
+        self.logger.log({
+            "event": "digest_resume",
+            "round": int(start_round),
+            "ok": bool(ok),
+            "head_round": int(self._digest_prev_round),
+            "head": self._digest_prev,
+            "detail": detail,
+        })
+        if not ok and self.cfg.run.obs.digest.strict:
+            raise digest_mod.DigestResumeError(
+                f"digest chain verification failed on resume at round "
+                f"{start_round}: {detail}"
+            )
+
+    def replay_round(self, target_round: int) -> Dict[str, Any]:
+        """Re-execute exactly one logged digest round — the "reproduce
+        round 4 317 on my desk" workflow behind ``colearn replay``.
+
+        Restores the nearest checkpoint at or before the target
+        record's window start (round 0's deterministic init is the
+        virtual step-0 checkpoint), re-runs the intervening rounds
+        UNFUSED (the catch-up twin — digest streams are fuse-invariant
+        by construction), recomputes the target boundary's digest from
+        the re-realized schedule/wire/state, and compares it component
+        by component against the logged record. Sync rounds replay
+        exactly; snapshot-fed sampling (adaptive/streaming) replays
+        exactly only when the window does not cross a sampler-refresh
+        boundary (the refresh rides metrics flushes the replay loop
+        does not perform) — the schedule component catches the
+        difference rather than hiding it."""
+        if not self._digest_on:
+            raise ValueError(
+                "replay requires run.obs.digest.enabled=true (the "
+                "digest config must match the recorded run)"
+            )
+        target_round = int(target_round)
+        records = self._load_own_records()
+        stream = digest_mod.digest_records(records)
+        by_round = {int(r["round"]): r for r in stream}
+        rec = by_round.get(target_round)
+        if rec is None:
+            have = ", ".join(str(r) for r in sorted(by_round)[:12])
+            raise ValueError(
+                f"no round_digest record at round {target_round} in "
+                f"{self.logger.path} (digest rounds: {have or 'none'})"
+            )
+        window_start = int(rec["prev_round"])
+        store = self._ckpt_store()
+        steps = [
+            s for s in (store.steps() if store else [])
+            if s <= window_start
+        ]
+        if steps:
+            state, step = store.restore(template=self.init_state(),
+                                        step=steps[-1])
+        else:
+            # round 0: init_state is seed-deterministic — the virtual
+            # step-0 checkpoint every run starts from
+            state, step = self.init_state(), 0
+        if store is not None:
+            store.close()
+        state.pop("digest_head", None)
+        state = self._place_state(state)
+        if self._ledger_on:
+            self._ledger_ref = state.get("ledger")
+        if self._snapshot_refresh:
+            self._seed_sampler_from_state(state)
+        self._digest_cohorts.clear()
+        self._digest_window = digest_mod.RoundWindow()
+        for r in range(step, target_round):
+            state = self.run_round(state, r, fuse_override=1)
+            if self._ledger_on:
+                self._ledger_ref = state.get("ledger")
+            self._carry_host_ledger_state(state)
+            state.pop("_metrics", None)
+            comm = self._comm_stats.pop(r, None)
+            fail = self._fail_stats.pop(r, None)
+            cohort = self._digest_cohorts.pop(r, None)
+            for scratch in (self._async_stats, self._hier_stats,
+                            self._attack_stats, self._phase_costs):
+                scratch.pop(r, None)
+            if r + 1 > window_start:
+                # rounds at or before the window start were digested
+                # by an EARLIER boundary in the original run
+                self._digest_window.observe(r + 1, cohort, comm, fail)
+        comp = self._compute_digest(target_round, state)
+        replayed_self = digest_mod.chain_digest(
+            rec.get("prev", digest_mod.GENESIS), target_round, comp
+        )
+        logged = digest_mod.components_from_record(rec)
+        components = {
+            name: comp[name] == logged.get(name)
+            for name in digest_mod.COMPONENT_ORDER
+        }
+        leaves = sorted(
+            set(comp["params_leaves"]) | set(logged["params_leaves"])
+        )
+        return {
+            "round": target_round,
+            "checkpoint_step": int(step),
+            "replayed_rounds": target_round - int(step),
+            "match": replayed_self == rec.get("self"),
+            "logged": rec.get("self"),
+            "replayed": replayed_self,
+            "components": components,
+            "params_leaves_diverged": [
+                k for k in leaves
+                if comp["params_leaves"].get(k)
+                != logged["params_leaves"].get(k)
+            ],
+        }
 
     # ------------------------------------------------------------------
 

@@ -59,6 +59,11 @@ class CheckpointStore:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def steps(self):
+        """All persisted steps, ascending — `colearn replay` picks the
+        nearest one at or before its target window's start."""
+        return sorted(int(s) for s in self._mngr.all_steps())
+
     def restore(self, step: Optional[int] = None, template: Optional[Dict[str, Any]] = None):
         # an in-flight async save must land before it can be restored
         self._mngr.wait_until_finished()

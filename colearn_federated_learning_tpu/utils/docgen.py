@@ -190,6 +190,28 @@ _SECTIONS = [
      "mid-fit), `colearn population <run>` the post-hoc report; "
      "`colearn summarize` surfaces the run_summary totals. See "
      "docs/DESIGN.md \"Federation health observatory\"."),
+    ("run.obs.digest", config_mod.DigestConfig,
+     "Determinism flight recorder (obs/digest.py): at each digest "
+     "boundary (`every` rounds; must land on fused-chunk ends under "
+     "run.fuse_rounds) the driver hashes the fetched round state — "
+     "params (per-top-level-leaf AND rolled up), optimizer state, the "
+     "ledger/pager hot set, the realized cohort schedule + failure "
+     "stats, the per-round wire-byte counters, and the RNG inputs — "
+     "into one `round_digest` JSONL record whose `self` hash chains "
+     "over `prev`, so a truncated or tampered log is self-evident. "
+     "The chain head rides every checkpoint and is re-verified "
+     "against the log on resume (`verify_resume`; warn, or abort "
+     "with `strict` / `colearn fit --strict-digest`). Digests are "
+     "pure functions of fetched state: identical across engines "
+     "where engines are bitwise, invariant to fuse_rounds and flush "
+     "cadence, and digest-on leaves the params trajectory bitwise "
+     "unchanged. `colearn diff <a> <b>` aligns two runs' chains and "
+     "names the first divergent round + component (params leaf / opt "
+     "/ ledger / schedule / wire / rng); `colearn replay <run> "
+     "--round r` re-executes one round from the nearest checkpoint "
+     "and verifies the recomputed digest. Off by default (and in "
+     "benches — the digest fetch is host-exposed time). See "
+     "docs/DESIGN.md \"Determinism flight recorder\"."),
 ]
 
 # appended under the `attack` section table (kept here so the generated

@@ -1,0 +1,289 @@
+"""Determinism flight recorder (run.obs.digest, obs/digest.py): canon
+hashing units, hash-chain verification + tamper/truncation detection,
+checkpoint-head packing, and the e2e pins — digest streams identical
+across engines × fuse widths and through a resume boundary, digest-on
+bitwise-identical params to digest-off, and strict resume verification
+aborting on a tampered log."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.obs import digest as D
+
+
+# ---------------------------------------------------------------------------
+# unit: canonical hashing
+
+
+def test_array_digest_tags_dtype_and_shape():
+    a = np.arange(6, dtype=np.float32)
+    # same bytes, different dtype → different digest
+    assert D.array_digest(a) != D.array_digest(a.view(np.int32))
+    # same bytes, different shape → different digest
+    assert D.array_digest(a) != D.array_digest(a.reshape(2, 3))
+    # value change → different digest; identity → equal
+    b = a.copy()
+    assert D.array_digest(a) == D.array_digest(b)
+    b[3] += 1
+    assert D.array_digest(a) != D.array_digest(b)
+
+
+def test_array_digest_noncontiguous_matches_contiguous_copy():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    view = a[:, ::2]
+    assert D.array_digest(view) == D.array_digest(np.ascontiguousarray(view))
+
+
+def test_json_digest_is_key_order_invariant():
+    assert D.json_digest({"a": 1, "b": [2, 3]}) == \
+        D.json_digest({"b": [2, 3], "a": 1})
+    assert D.json_digest({"a": 1}) != D.json_digest({"a": 2})
+
+
+def test_tree_digest_is_path_sensitive():
+    x = np.ones(3, np.float32)
+    # same leaves under different keys must not collide
+    assert D.tree_digest({"w": x, "b": x * 2}) != \
+        D.tree_digest({"b": x, "w": x * 2})
+    # dict ordering is canonicalized
+    t1 = {"w": x, "b": x * 2}
+    t2 = dict(reversed(list(t1.items())))
+    assert D.tree_digest(t1) == D.tree_digest(t2)
+
+
+def test_params_digests_rollup_and_per_leaf():
+    params = {"Dense_0": {"kernel": np.ones((2, 2), np.float32)},
+              "Dense_1": {"kernel": np.zeros((2, 2), np.float32)}}
+    rollup, leaves = D.params_digests(params)
+    assert set(leaves) == {"Dense_0", "Dense_1"}
+    perturbed = {"Dense_0": {"kernel": np.full((2, 2), 2.0, np.float32)},
+                 "Dense_1": params["Dense_1"]}
+    rollup2, leaves2 = D.params_digests(perturbed)
+    assert rollup != rollup2
+    assert leaves["Dense_0"] != leaves2["Dense_0"]
+    assert leaves["Dense_1"] == leaves2["Dense_1"]
+
+
+def test_head_pack_unpack_roundtrip_and_genesis():
+    hex16 = "00ffee11aa22bb33"
+    head = D.head_pack(hex16, 37)
+    assert head.dtype == np.uint32 and head.shape == (3,)
+    assert D.head_unpack(head) == (hex16, 37)
+    assert D.head_unpack(np.zeros(3, np.uint32)) == (D.GENESIS, 0)
+
+
+# ---------------------------------------------------------------------------
+# unit: chain semantics over synthetic records
+
+
+def _synthetic_chain(n=4):
+    recs, prev, prev_round = [], D.GENESIS, 0
+    for r in range(1, n + 1):
+        comps = {
+            "params": D.json_digest({"r": r}),
+            "opt": D.json_digest({"o": r}),
+            "ledger": D.json_digest(None),
+            "schedule": D.json_digest({"s": r}),
+            "wire": D.json_digest({"w": r}),
+            "rng": D.json_digest({"seed": 0, "round": r}),
+            "params_leaves": {"Dense_0": D.json_digest({"leaf": r})},
+        }
+        self_hex = D.chain_digest(prev, r, comps)
+        recs.append({"event": "round_digest", "round": r,
+                     "prev_round": prev_round, "prev": prev,
+                     "self": self_hex, **comps})
+        prev, prev_round = self_hex, r
+    return recs
+
+
+def test_verify_chain_accepts_valid_and_prefix():
+    recs = _synthetic_chain(4)
+    ok, problems = D.verify_chain(recs)
+    assert ok and not problems
+    # a truncated log is a valid chain PREFIX — truncation is caught by
+    # the checkpoint head on resume or the longer twin in diff, not here
+    ok, _ = D.verify_chain(recs[:2])
+    assert ok
+
+
+def test_verify_chain_detects_tampered_component():
+    recs = _synthetic_chain(4)
+    recs[2] = dict(recs[2], params="f" * D.HEX_WIDTH)
+    ok, problems = D.verify_chain(recs)
+    assert not ok
+    assert any("round 3" in p for p in problems)
+
+
+def test_verify_chain_detects_spliced_link():
+    recs = _synthetic_chain(4)
+    # splice: replace record 3's prev with a forged value AND recompute
+    # its self so the record is internally consistent — only the LINK
+    # to the previous record is broken
+    forged_prev = "a" * D.HEX_WIDTH
+    comps = D.components_from_record(recs[2])
+    self_hex = D.chain_digest(forged_prev, 3, comps)
+    recs[2] = dict(recs[2], prev=forged_prev, self=self_hex)
+    ok, problems = D.verify_chain(recs)
+    assert not ok
+
+
+def test_digest_records_last_wins_per_round():
+    recs = _synthetic_chain(3)
+    # crash-retry re-emission: a duplicate round record — last wins
+    dup = dict(recs[1])
+    stream = D.digest_records(recs[:2] + [dup] + recs[2:])
+    assert [r["round"] for r in stream] == [1, 2, 3]
+
+
+def test_diff_streams_localizes_component_and_continuation():
+    a = _synthetic_chain(4)
+    assert D.diff_streams(a, a)["status"] == "match"
+    # identical prefix + longer tail = a continuation, not a divergence
+    assert D.diff_streams(a[:2], a)["status"] == "match"
+    # rebuild b with a perturbed round-3 schedule (self hashes rechain)
+    b, prev, prev_round = [], D.GENESIS, 0
+    for rec in a:
+        comps = D.components_from_record(rec)
+        if rec["round"] == 3:
+            comps = dict(comps, schedule=D.json_digest({"s": "evil"}))
+        self_hex = D.chain_digest(prev, rec["round"], comps)
+        b.append(dict(rec, prev=prev, prev_round=prev_round,
+                      self=self_hex, **comps))
+        prev, prev_round = self_hex, rec["round"]
+    rep = D.diff_streams(a, b)
+    assert rep["status"] == "diverged"
+    assert rep["first_divergent_round"] == 3
+    assert rep["component"] == "schedule"
+
+
+# ---------------------------------------------------------------------------
+# e2e: tiny fits
+
+
+def _cfg(tmp, engine="sharded", rounds=4, every=1, fuse=1, digest=True,
+         **overrides):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "server.num_rounds": rounds, "server.eval_every": rounds,
+        "server.checkpoint_every": 2, "server.cohort_size": 2,
+        "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 64, "client.batch_size": 16,
+        "run.out_dir": str(tmp), "run.metrics_flush_every": 2,
+        "run.engine": engine, "run.fuse_rounds": fuse,
+        "run.obs.digest.enabled": digest, "run.obs.digest.every": every,
+        **overrides,
+    })
+    return cfg.validate()
+
+
+def _fit(cfg):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    path = os.path.join(cfg.run.out_dir, f"{cfg.name}.metrics.jsonl")
+    return exp, state, [json.loads(l) for l in open(path)], path
+
+
+def _digest_map(recs):
+    return {r["round"]: r["self"] for r in D.digest_records(recs)}
+
+
+def test_digest_stream_identical_across_engines_and_fuse(tmp_path):
+    streams = {}
+    for key, (engine, fuse) in {
+        "seq": ("sequential", 1), "sharded": ("sharded", 1),
+        "fused": ("sharded", 4),
+    }.items():
+        # every=4 so digest boundaries land on fused-chunk ends in all
+        # three variants (validate() enforces the alignment when fused)
+        cfg = _cfg(tmp_path / key, engine, rounds=4, every=4, fuse=fuse,
+                   **{"server.checkpoint_every": 4})
+        _, _, recs, _ = _fit(cfg)
+        ok, problems = D.verify_chain(recs)
+        assert ok, problems
+        streams[key] = _digest_map(recs)
+        assert streams[key], "no round_digest records"
+    assert streams["seq"] == streams["sharded"] == streams["fused"]
+
+
+def test_digest_chain_continues_through_resume(tmp_path):
+    _fit(_cfg(tmp_path, rounds=4))
+    exp, _, recs, _ = _fit(_cfg(tmp_path, rounds=6,
+                                **{"run.resume": True}))
+    # resume verification logged ok against the checkpoint's chain head
+    dr = [r for r in recs if r.get("event") == "digest_resume"]
+    assert dr and dr[-1]["ok"], dr
+    assert dr[-1]["head_round"] == 4
+    # the chain spans the boundary unbroken, one digest per round
+    ok, problems = D.verify_chain(recs)
+    assert ok, problems
+    assert sorted(_digest_map(recs)) == [1, 2, 3, 4, 5, 6]
+    # and matches an uninterrupted 6-round run digest-for-digest
+    _, _, recs_u, _ = _fit(_cfg(tmp_path / "uninterrupted", rounds=6))
+    assert _digest_map(recs) == _digest_map(recs_u)
+
+
+def test_digest_on_is_bitwise_invisible_to_params(tmp_path):
+    import jax
+
+    _, state_off, recs_off, _ = _fit(
+        _cfg(tmp_path / "off", rounds=3, digest=False))
+    _, state_on, recs_on, _ = _fit(
+        _cfg(tmp_path / "on", rounds=3, digest=True))
+    assert not any(r.get("event") == "round_digest" for r in recs_off)
+    assert any(r.get("event") == "round_digest" for r in recs_on)
+    for a, b in zip(jax.tree.leaves(state_off["params"]),
+                    jax.tree.leaves(state_on["params"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_strict_resume_aborts_on_tampered_log(tmp_path):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    cfg = _cfg(tmp_path, rounds=4)
+    _fit(cfg)
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    lines = open(path).read().splitlines()
+    out = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("event") == "round_digest" and rec["round"] == 3:
+            rec["params"] = "f" * D.HEX_WIDTH  # tamper one component
+        out.append(json.dumps(rec))
+    open(path, "w").write("\n".join(out) + "\n")
+    cfg2 = _cfg(tmp_path, rounds=6, **{"run.resume": True,
+                                       "run.obs.digest.strict": True})
+    with pytest.raises(D.DigestResumeError):
+        Experiment(cfg2, echo=False).fit()
+    # the failed verification is itself on the record
+    recs = [json.loads(l) for l in open(path)]
+    dr = [r for r in recs if r.get("event") == "digest_resume"]
+    assert dr and not dr[-1]["ok"]
+
+
+def test_truncated_log_is_caught_by_checkpoint_head(tmp_path):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    cfg = _cfg(tmp_path, rounds=4)
+    _fit(cfg)
+    path = os.path.join(str(tmp_path), f"{cfg.name}.metrics.jsonl")
+    kept = [l for l in open(path).read().splitlines()
+            if not (json.loads(l).get("event") == "round_digest"
+                    and json.loads(l)["round"] >= 3)]
+    open(path, "w").write("\n".join(kept) + "\n")
+    cfg2 = _cfg(tmp_path, rounds=6, **{"run.resume": True,
+                                       "run.obs.digest.strict": True})
+    with pytest.raises(D.DigestResumeError, match="truncat"):
+        Experiment(cfg2, echo=False).fit()
+
+
+def test_validate_rejects_misaligned_digest_cadence(tmp_path):
+    with pytest.raises(ValueError, match="fuse_rounds"):
+        _cfg(tmp_path, engine="sharded", rounds=4, every=1, fuse=4,
+             **{"server.checkpoint_every": 4})

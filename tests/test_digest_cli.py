@@ -1,0 +1,265 @@
+"""`colearn diff` / `colearn replay` — the flight recorder's pure-host
+bisection and single-round re-execution CLIs — plus the satellite
+consumer surfaces: `summarize` rendering the async/hier totals and
+`watch` rendering the digest-chain status line."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import cli
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.obs import digest as D
+from colearn_federated_learning_tpu.obs.population import (
+    format_watch,
+    watch_snapshot,
+)
+from colearn_federated_learning_tpu.obs.summary import (
+    format_summary,
+    load_records,
+    summarize_records,
+)
+
+CFG_OVERRIDES = {
+    "server.num_rounds": 4, "server.eval_every": 4,
+    "server.checkpoint_every": 2, "server.cohort_size": 2,
+    "data.synthetic_train_size": 256, "data.synthetic_test_size": 64,
+    "data.max_examples_per_client": 64, "client.batch_size": 16,
+    "run.metrics_flush_every": 2, "run.engine": "sharded",
+    "run.obs.digest.enabled": True,
+}
+
+
+def _cfg(tmp, **overrides):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({**CFG_OVERRIDES, "run.out_dir": str(tmp),
+                         **overrides})
+    return cfg.validate()
+
+
+def _fit(cfg, experiment_cls=None):
+    from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+    exp = (experiment_cls or Experiment)(cfg, echo=False)
+    exp.fit()
+    return os.path.join(cfg.run.out_dir, f"{cfg.name}.metrics.jsonl")
+
+
+class _PerturbedAtRound3:
+    """Mixin factory: an Experiment whose round 3 nudges one params
+    leaf — the injected single-bit-flip stand-in the diff must localize
+    to exactly (round 3, params, first leaf)."""
+
+    @staticmethod
+    def make():
+        import jax
+
+        from colearn_federated_learning_tpu.server.round_driver import (
+            Experiment,
+        )
+
+        class Perturbed(Experiment):
+            def run_round(self, state, round_idx, fuse_override=None):
+                state = super().run_round(state, round_idx, fuse_override)
+                if round_idx == 2:  # 0-based → digest round 3
+                    params = dict(state["params"])
+                    key = sorted(params, key=str)[0]
+                    leaves, treedef = jax.tree.flatten(params[key])
+                    leaves[0] = leaves[0] + np.float32(1e-3)
+                    params[key] = jax.tree.unflatten(treedef, leaves)
+                    state = dict(state)
+                    state["params"] = params
+                return state
+
+        return Perturbed
+
+
+@pytest.fixture(scope="module")
+def runs(tmp_path_factory):
+    """One recorded federation, three views of it: the run itself
+    (plus the saved 4-round prefix of its log before it was resumed to
+    6 rounds), an identical twin, and a twin perturbed at round 3."""
+    tmp = tmp_path_factory.mktemp("digest_cli")
+    dir_a, dir_b, dir_p = tmp / "a", tmp / "b", tmp / "p"
+    path_a = _fit(_cfg(dir_a))
+    prefix = str(tmp / "a_prefix.metrics.jsonl")
+    shutil.copyfile(path_a, prefix)
+    _fit(_cfg(dir_a, **{"server.num_rounds": 6, "run.resume": True}))
+    path_b = _fit(_cfg(dir_b, **{"server.num_rounds": 6}))
+    path_p = _fit(_cfg(dir_p, **{"server.num_rounds": 6}),
+                  experiment_cls=_PerturbedAtRound3.make())
+    return {"a": path_a, "a_prefix": prefix, "b": path_b, "p": path_p,
+            "dirs": {"a": str(dir_a), "b": str(dir_b), "p": str(dir_p)}}
+
+
+# ---------------------------------------------------------------------------
+# colearn diff
+
+
+def test_diff_identical_twins_exit_0(runs, capsys):
+    assert cli.main(["diff", runs["a"], runs["b"]]) == 0
+    out = capsys.readouterr().out
+    assert "no divergence" in out
+
+
+def test_diff_prefix_vs_own_continuation_exit_0(runs):
+    # a run versus its own resumed continuation is a match, not a
+    # divergence — common rounds agree, the tail is just longer
+    assert cli.main(["diff", runs["a_prefix"], runs["a"]]) == 0
+    assert cli.main(["diff", runs["a"], runs["a_prefix"]]) == 0
+
+
+def test_diff_perturbed_twin_names_round_and_leaf(runs, capsys):
+    rc = cli.main(["diff", runs["b"], runs["p"], "--json"])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "diverged"
+    assert rep["first_divergent_round"] == 3
+    assert rep["component"] == "params"
+    assert rep["params_leaves"], rep
+    # the table names the same localization
+    assert cli.main(["diff", runs["b"], runs["p"]]) == 1
+    out = capsys.readouterr().out
+    assert "round 3" in out and "params" in out
+    assert rep["params_leaves"][0] in out
+
+
+def test_diff_tampered_chain_exit_1(runs, tmp_path, capsys):
+    tampered = str(tmp_path / "tampered.metrics.jsonl")
+    with open(runs["b"]) as src, open(tampered, "w") as dst:
+        for line in src:
+            rec = json.loads(line)
+            if rec.get("event") == "round_digest" and rec["round"] == 2:
+                rec["opt"] = "f" * D.HEX_WIDTH
+            dst.write(json.dumps(rec) + "\n")
+    rc = cli.main(["diff", runs["b"], tampered, "--json"])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "chain_broken"
+    assert rep["chain_a_ok"] and not rep["chain_b_ok"]
+
+
+def test_diff_without_digest_records_exit_2(runs, tmp_path, capsys):
+    bare = str(tmp_path / "bare.metrics.jsonl")
+    open(bare, "w").write(json.dumps({"round": 1, "train_loss": 1.0}) + "\n")
+    assert cli.main(["diff", runs["a"], bare]) == 2
+    assert "run.obs.digest.enabled" in capsys.readouterr().err
+
+
+def test_diff_missing_run_exit_2(runs, capsys):
+    assert cli.main(["diff", runs["a"], "/nonexistent/run"]) == 2
+    assert capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# colearn replay
+
+
+def _replay_args(run_dir, rounds, round_no):
+    sets = [f"{k}={v}" for k, v in CFG_OVERRIDES.items()
+            if k != "run.metrics_flush_every"]
+    sets += [f"server.num_rounds={rounds}", "run.metrics_flush_every=2"]
+    args = ["replay", "--config", "mnist_fedavg_2",
+            "--out-dir", run_dir, "--round", str(round_no)]
+    for s in sets:
+        args += ["--set", s]
+    return args
+
+
+def test_replay_reproduces_logged_digest(runs, capsys):
+    rc = cli.main(_replay_args(runs["dirs"]["b"], 6, 4))
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["match"] is True
+    assert rep["round"] == 4
+    assert all(rep["components"].values()), rep
+    # replay restored a real checkpoint, not genesis: prev_round 3 →
+    # nearest persisted step at or before it is 2 (checkpoint_every=2)
+    assert rep["checkpoint_step"] == 2
+    assert rep["replayed_rounds"] == 2
+
+
+def test_replay_localizes_a_divergent_recording(runs, capsys):
+    # the perturbed twin's LOG holds round-3 digests of nudged params;
+    # an honest re-execution must refuse to confirm them
+    rc = cli.main(_replay_args(runs["dirs"]["p"], 6, 3))
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["match"] is False
+    assert rep["components"]["params"] is False
+    assert rep["components"]["schedule"] is True  # same cohort draw
+    assert rep["params_leaves_diverged"], rep
+
+
+def test_replay_unknown_round_exit_2(runs, capsys):
+    assert cli.main(_replay_args(runs["dirs"]["b"], 6, 99)) == 2
+    assert capsys.readouterr().err
+
+
+def test_replay_does_not_truncate_the_log(runs):
+    before = open(runs["b"]).read()
+    assert cli.main(_replay_args(runs["dirs"]["b"], 6, 2)) == 0
+    after = open(runs["b"]).read()
+    # append-mode logger: every original byte survives the replay
+    assert after.startswith(before)
+
+
+# ---------------------------------------------------------------------------
+# satellite surfaces: summarize + watch
+
+
+def test_summarize_surfaces_async_and_hier_totals():
+    records = [
+        {"round": 1, "train_loss": 1.0, "examples": 32, "schema": 1,
+         "time": 0.0},
+        {"event": "run_summary", "rounds": 1, "wall_time_sec": 1.0,
+         "compiles": 1, "compile_ms": 1.0, "schema": 1, "time": 1.0,
+         "upload_bytes": 1024, "upload_bytes_raw": 2048,
+         "download_bytes": 512, "download_bytes_raw": 512,
+         "async_updates_absorbed": 40, "async_updates_per_sec": 13.3,
+         "async_staleness_bound": 4, "async_staleness_p50": 1,
+         "async_staleness_p90": 2, "async_staleness_max": 3,
+         "async_per_version": {"0": 30, "1": 10},
+         "hier_core_upload_bytes": 4096},
+    ]
+    summary = summarize_records(records)
+    assert summary["async"]["async_staleness_p90"] == 2
+    assert summary["async_per_version"] == {"0": 30, "1": 10}
+    assert summary["hier_core_upload_bytes"] == 4096
+    table = format_summary(summary)
+    assert "staleness p50/p90/max 1/2/3 (bound 4)" in table
+    assert "v0: 30  v1: 10" in table
+    assert "hier core upload 4.0 KiB" in table
+
+
+def test_watch_renders_digest_chain_status(runs):
+    records = load_records(runs["a"])
+    snap = watch_snapshot(records)
+    assert snap["digest"]["chain_ok"]
+    assert snap["digest"]["last_round"] == 6
+    frame = format_watch(snap)
+    assert "digest: chain OK through round 6" in frame
+    # tampered log → BROKEN, naming the first problem
+    bad = [dict(r) for r in records]
+    for r in bad:
+        if r.get("event") == "round_digest" and r["round"] == 2:
+            r["wire"] = "f" * D.HEX_WIDTH
+    frame = format_watch(watch_snapshot(bad))
+    assert "chain BROKEN" in frame
+    # a failed resume verification is flagged on the same line
+    bad.append({"event": "digest_resume", "round": 4, "ok": False,
+                "head_round": 4, "head": "0" * D.HEX_WIDTH,
+                "detail": "head mismatch at round 4"})
+    frame = format_watch(watch_snapshot(bad))
+    assert "RESUME-VERIFY FAILED" in frame
+
+
+def test_watch_without_digests_has_no_digest_line(runs):
+    records = [r for r in load_records(runs["a"])
+               if r.get("event") not in ("round_digest", "digest_resume")]
+    snap = watch_snapshot(records)
+    assert "digest" not in snap
+    assert "digest:" not in format_watch(snap)
