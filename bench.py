@@ -111,6 +111,14 @@ _SHAPES = {
     # dtype policy — now recorded via compute_dtype/mfu_basis extras.
     "cifar10_fedavg_100": (4, 16, {"run.fuse_rounds": 4,
                                    "server.fused_apply": True}),
+    # ISSUE 18: the headline config's device-control-plane twin —
+    # identical workload + fusion, but cohort/churn/slab derivation is
+    # lowered into the round program (server/device_plane.py) so host
+    # I/O collapses to flush boundaries. Bench-report's mode column and
+    # the host_exposed_pct gate read the two entries side by side.
+    "cifar10_fedavg_100_device": (4, 16, {"run.fuse_rounds": 4,
+                                          "server.fused_apply": True,
+                                          "run.control_plane": "device"}),
     # r6: round fusion adopted for the dispatch-sensitive shapes — the
     # generalized fused scan now covers robust/attack/EF paths, and the
     # plain configs take the dispatch amortization directly (warmup and
@@ -127,6 +135,13 @@ _SHAPES = {
     "shakespeare_fedavg": (10, 20, {}),
     "imagenet_silo_dp": (1, 3, {"data.max_examples_per_client": 128}),
 }
+
+
+def _base_shape_name(name: str) -> str:
+    # the *_device twins bench a named config under the device control
+    # plane — same workload, the mode override rides in the entry's
+    # overrides dict
+    return name[: -len("_device")] if name.endswith("_device") else name
 
 
 def _round_flops(exp, state):
@@ -303,7 +318,8 @@ def bench_config(name: str):
     from colearn_federated_learning_tpu.server.round_driver import Experiment
 
     warmup, timed, overrides = _SHAPES[name]
-    cfg = get_named_config(name)
+    base_name = _base_shape_name(name)
+    cfg = get_named_config(base_name)
     cfg.server.num_rounds = warmup + timed
     cfg.server.eval_every = 0
     cfg.server.checkpoint_every = 0
@@ -311,7 +327,7 @@ def bench_config(name: str):
     # synthetic corpora at the real datasets' cardinality (zero egress —
     # real files absent); the per-config synthetic sizes already match
     # except the 100-client config, pinned at CIFAR's 50k here
-    if name == "cifar10_fedavg_100":
+    if base_name == "cifar10_fedavg_100":
         cfg.data.synthetic_train_size = 50_000
         cfg.data.synthetic_test_size = 1_000
     cfg.apply_overrides(overrides)
@@ -424,6 +440,10 @@ def bench_config(name: str):
         # the GEMM batch — throughput/MFU numbers under the two layouts
         # are different machines, so every result records which one ran
         "cohort_layout": cfg.run.cohort_layout,
+        # control plane (ISSUE 18): device mode derives cohorts/churn in
+        # the round program, so the host-exposed share is a different
+        # machine — every result records which plane produced it
+        "control_plane": cfg.run.control_plane,
         # the per-client forensic ledger adds an in-program stats block
         # + scatter to every round — throughput numbers with it on are
         # not comparable to ledger-off pins, so record the switch
@@ -616,6 +636,7 @@ def bench_weak_scale(name: str):
         "n_chips": exp.n_chips,
         "client_updates_per_sec_per_chip": round(ups_chip, 4),
         "cohort_layout": cfg.run.cohort_layout,
+        "control_plane": cfg.run.control_plane,
         "fused_apply": bool(cfg.server.fused_apply),
         "num_clients": cfg.data.num_clients,
         "timed_rounds": timed,
@@ -794,6 +815,7 @@ def bench_async_throughput(name: str):
                 ),
                 "lora": False,
                 "cohort_layout": cfg.run.cohort_layout,
+                "control_plane": cfg.run.control_plane,
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),
@@ -962,6 +984,7 @@ def bench_hier_async(name: str):
                 "meets_budget": meets,
                 "lora": False,
                 "cohort_layout": cfg.run.cohort_layout,
+                "control_plane": cfg.run.control_plane,
             },
         }
     finally:
@@ -1081,6 +1104,7 @@ def bench_store_scale(name: str):
                 "pager_hit_rate": pop_totals.get("pager_hit_rate"),
                 "lora": False,
                 "cohort_layout": cfg.run.cohort_layout,
+                "control_plane": cfg.run.control_plane,
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),
@@ -1192,6 +1216,7 @@ def bench_lora_scale(name: str):
                 "lora_rank": cfg.model.lora.rank,
                 "lora_target": cfg.model.lora.target,
                 "cohort_layout": cfg.run.cohort_layout,
+                "control_plane": cfg.run.control_plane,
                 "wire_reduction_vs_full": round(
                     exp.wire_reduction_vs_full(), 2
                 ),

@@ -185,6 +185,11 @@ FEATURES: Dict[str, Feature] = {
     "digest": Feature({"run.obs.digest.enabled": True}, False,
                       "determinism flight recorder (driver-level digest "
                       "of fetched state; never reaches the engine)"),
+    "control_plane_device": Feature(
+        {"run.control_plane": "device"}, False,
+        "device-resident control plane (server/device_plane.py): "
+        "cohort/churn/slab derivation lowered into the round program; "
+        "driver-level — the engines run unchanged under the wrapper"),
 }
 
 

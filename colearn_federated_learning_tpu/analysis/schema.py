@@ -152,15 +152,15 @@ REGISTRY: Dict[str, RecordSpec] = {
     ),
     "precision": RecordSpec(
         required=("param_dtype", "compute_dtype", "local_param_dtype",
-                  "fused_apply", "double_buffer"),
-        doc="dtype/fusion provenance at fit start",
+                  "fused_apply", "double_buffer", "control_plane"),
+        doc="dtype/fusion/control-plane provenance at fit start",
     ),
     "phase_cost_model": RecordSpec(
         required=("step_flops", "flop_source", "n_coords", "n_coords_full",
                   "param_bytes", "compute_bytes", "mfu_basis", "peak_flops",
                   "peak_hbm_bytes_per_sec", "n_chips", "process_index",
                   "cohort_layout", "clients_per_lane", "gemm_rows",
-                  "mxu_tile_pad_fraction"),
+                  "lora_all_steps", "mxu_tile_pad_fraction"),
         doc="static half of the roofline cost model (obs/roofline.py)",
     ),
     "phase_cost": RecordSpec(

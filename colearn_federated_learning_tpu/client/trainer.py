@@ -107,6 +107,21 @@ def _select_tree(pred, new, old):
     return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
 
 
+class _DecomposedLoRA:
+    """Megabatch view of a LoRA model: ``apply`` delegates to
+    ``apply_decomposed`` (models/lora.py) so the frozen base is never
+    merged into per-client kernels — its weights stay closure constants
+    and contract the flattened megabatch un-batched in every local
+    step. Exposes only what the loss factory reads."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.compute_dtype = getattr(inner, "compute_dtype", jnp.float32)
+
+    def apply(self, variables, *args, **kwargs):
+        return self._inner.apply_decomposed(variables, *args, **kwargs)
+
+
 def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task: str,
                         batch_axis: str | None = None, local_dtype=None,
                         scan_unroll: int = 1, megabatch: bool = False):
@@ -164,6 +179,17 @@ def make_local_train_fn(model, client_cfg: ClientConfig, dp_cfg: DPConfig, task:
     """
     fused_sgd = client_cfg.optimizer == "sgd"
     opt = None if fused_sgd else make_client_optimizer(client_cfg)
+    if megabatch and hasattr(model, "apply_decomposed"):
+        # All-steps LoRA megabatch: with the merged apply, the diverged
+        # phase's per-client vmap batches EVERY base GEMM (C merged
+        # kernel copies); the decomposed apply keeps the frozen base as
+        # a closure constant — only the tiny A/B factors batch — so
+        # the dominant contractions stay [C·batch, ·] × un-batched
+        # weight in every local step, not just step 0. Spatial and
+        # non-megabatch LoRA keep the merged apply bitwise-unchanged;
+        # megabatch parity vs spatial is pinned at the documented
+        # GEMM-reassociation tolerance.
+        model = _DecomposedLoRA(model)
     grad_fn = jax.value_and_grad(make_loss_fn(model, task))
     sum_grad_fn = jax.value_and_grad(make_loss_fn(model, task, reduction="sum"))
     mu = client_cfg.prox_mu

@@ -1074,6 +1074,31 @@ class RunConfig:
     # schedule on the same pipeline kind — pin "native" or "numpy"
     # explicitly if a run may migrate across machines mid-flight.
     host_pipeline: str = "auto"
+    # Round control plane (ISSUE 18):
+    #   host   — the legacy path: sampler draws, churn realization, and
+    #            index-slab construction run in host Python between
+    #            dispatches (bitwise-identical to pre-knob builds).
+    #   device — the control plane lowers into the round program
+    #            (server/device_plane.py): cohort ids come from a tiny
+    #            precomputed per-round table, churn gates are evaluated
+    #            in-program by a uint32-pair SplitMix64 bitwise-equal
+    #            to server/churn.py's host draws, and the index slab is
+    #            derived from a device-resident shard table — the host
+    #            ships nothing per round, and under fuse_rounds > 1 the
+    #            fused scan derives every sub-round's schedule itself,
+    #            so host I/O collapses to flush boundaries. Cohort ids
+    #            and churn fail stats stay bitwise-equal to host mode;
+    #            per-batch example ORDER is the device plane's own
+    #            seed-pure rotation discipline (documented in
+    #            DESIGN.md). The realized schedule is emitted as a
+    #            fetched-at-flush program output. Requires the
+    #            fixed/uniform sampler, hbm placement, and the
+    #            sharded/sequential engines; samplers that need host
+    #            state (adaptive/streaming), fedbuff/gossip/hierarchy,
+    #            attacks, secagg, and per-round host protocols are
+    #            rejected with reasons (capability matrix
+    #            `control_plane_device`).
+    control_plane: str = "host"
     # rounds between metric fetches. Dispatch is async; only host fetches
     # pay the device round-trip (~100ms through this sandbox's relay), so
     # the driver buffers per-round metric scalars on device and drains
@@ -1778,6 +1803,111 @@ class ExperimentConfig:
             )
         if self.run.host_pipeline not in ("auto", "native", "numpy"):
             raise ValueError(f"unknown run.host_pipeline {self.run.host_pipeline!r}")
+        if self.run.control_plane not in ("host", "device"):
+            raise ValueError(
+                f"unknown run.control_plane {self.run.control_plane!r}; "
+                f"allowed: host | device"
+            )
+        if self.run.control_plane == "device":
+            # the device plane derives the whole schedule in-program
+            # from (seed, round) — anything that injects per-round HOST
+            # state into the schedule (adaptive scores, fedbuff queues,
+            # secagg key protocols, host_rng failure draws) cannot
+            # lower and is rejected with its reason (capability matrix
+            # feature `control_plane_device`)
+            if self.server.sampling != "uniform":
+                raise ValueError(
+                    f"run.control_plane='device' requires server."
+                    f"sampling='uniform' (got {self.server.sampling!r}: "
+                    f"weighted/poisson draw host-RNG shapes and "
+                    f"adaptive/streaming need per-round host score "
+                    f"state — they stay host-fed)"
+                )
+            if self.algorithm not in ("fedavg", "fedprox"):
+                raise ValueError(
+                    f"run.control_plane='device' supports fedavg/"
+                    f"fedprox only (got {self.algorithm!r}: scaffold/"
+                    f"feddyn thread host-gathered per-client state and "
+                    f"the fedbuff/gossip schedulers are host-resident)"
+                )
+            if self.run.engine not in ("sharded", "sequential"):
+                raise ValueError(
+                    f"run.control_plane='device' requires run.engine="
+                    f"sharded or sequential, got {self.run.engine!r}"
+                )
+            if self.data.placement != "hbm":
+                raise ValueError(
+                    "run.control_plane='device' requires data.placement="
+                    "'hbm' (stream slabs are built per round on host)"
+                )
+            if self.server.hierarchy.num_edges > 0:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "server.hierarchy (edge partitioning is a host "
+                    "scheduler)"
+                )
+            if self.server.secure_aggregation:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "secure_aggregation (per-round key protocol is "
+                    "host I/O)"
+                )
+            if self.attack.kind:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "attack simulation (byzantine masks are host-drawn "
+                    "per round)"
+                )
+            if self.server.error_feedback:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "server.error_feedback (the EF store gathers by "
+                    "host-assigned rows)"
+                )
+            if self.server.straggler_rate > 0 or self.server.dropout_rate > 0:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "server.straggler_rate/dropout_rate (host-RNG "
+                    "failure draws; use run.churn's seed-pure planes "
+                    "instead — they lower)"
+                )
+            if self.run.shape_buckets.enabled:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "run.shape_buckets (per-round grid re-shaping is a "
+                    "host decision; the device program has ONE shape)"
+                )
+            if self.run.host_pipeline == "native":
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "run.host_pipeline='native' (there is no host slab "
+                    "pipeline to accelerate)"
+                )
+            if self.run.churn.enabled and self.run.churn.trace:
+                raise ValueError(
+                    "run.control_plane='device' is incompatible with "
+                    "run.churn.trace (trace playback reads a host "
+                    "memmap; the analytic diurnal planes lower)"
+                )
+            cl_dev = self.run.obs.client_ledger
+            if (cl_dev.enabled and 0 < cl_dev.hot_capacity
+                    < self.data.num_clients):
+                raise ValueError(
+                    "run.control_plane='device' requires the DENSE "
+                    "client ledger (hot_capacity=0 or >= num_clients): "
+                    "paged slot assignment is a host-stateful remap"
+                )
+            if self.run.churn.enabled:
+                cells = self.server.num_rounds * self.data.num_clients
+                if cells > 4_194_304:
+                    raise ValueError(
+                        f"run.control_plane='device' with churn "
+                        f"precomputes a [num_rounds, num_clients] "
+                        f"availability-threshold table; {cells} cells "
+                        f"exceeds the 4194304 bound — shorten the run, "
+                        f"shrink the federation, or use "
+                        f"control_plane='host'"
+                    )
         if self.run.cohort_layout not in ("spatial", "megabatch"):
             raise ValueError(
                 f"unknown run.cohort_layout {self.run.cohort_layout!r}; "

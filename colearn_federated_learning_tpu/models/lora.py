@@ -41,6 +41,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -239,6 +240,58 @@ class LoRAModel:
             self._base_params, variables["params"], self.alpha, self.rank
         )
         return self.base.apply({"params": merged}, *args, **kwargs)
+
+    def apply_decomposed(self, variables, *args, **kwargs):
+        """The merge-free forward: run the FROZEN base with its own
+        params and add each target's low-rank side-path ``(x·A)·B ·
+        (alpha/r)`` to that Dense's output via a method interceptor —
+        ``W·x + s·(x·A)·B`` instead of ``(W + s·A·B)·x``. Same map up
+        to GEMM reassociation (distributivity; test-pinned tolerance),
+        but the base kernels stay closure constants: under the
+        megabatch layout's per-client ``vmap`` only A/B batch, so the
+        dominant base contractions see the flattened ``[C·batch, ·]``
+        rows against ONE un-batched weight in EVERY local step — the
+        merged ``apply`` would materialize C merged kernels and batch
+        every GEMM. The trainer routes the megabatch block through this
+        when present (client/trainer.py); every other consumer keeps
+        the merged ``apply`` bitwise-unchanged."""
+        if self._base_params is None:
+            raise RuntimeError(
+                "LoRAModel.apply_decomposed before any concrete init: "
+                "the frozen base params are bound by the first "
+                "non-abstract init(rng, x) call"
+            )
+        adapters = variables["params"]
+        # module paths of the adapted Dense layers — the kernel paths
+        # minus the trailing "kernel" key are exactly flax's
+        # context.module.path tuples
+        targets = {
+            p[:-1] for p in lora_target_paths(self._base_params, self.target)
+        }
+        scale = self.alpha / self.rank
+
+        def interceptor(next_fun, iargs, ikwargs, context):
+            if context.method_name != "__call__":
+                return next_fun(*iargs, **ikwargs)
+            path = tuple(context.module.path)
+            if path not in targets:
+                return next_fun(*iargs, **ikwargs)
+            x = iargs[0]
+            y = next_fun(*iargs, **ikwargs)
+            node = _get_path(adapters, path)
+            # rank-r side path in full f32 (the factors' stored dtype):
+            # under bf16 compute the merged apply folds s·A·B into W at
+            # f32 BEFORE the one cast, so a low-precision residual here
+            # would drift the trajectory well past reassociation level.
+            # The r-wide GEMMs are negligible next to the base
+            # contraction, so the upcast costs nothing that matters.
+            a = node["lora_a"].astype(jnp.float32)
+            b = node["lora_b"].astype(jnp.float32)
+            r = (x.astype(jnp.float32) @ a) @ b * jnp.float32(scale)
+            return (y.astype(jnp.float32) + r).astype(y.dtype)
+
+        with nn.intercept_methods(interceptor):
+            return self.base.apply({"params": self._base_params}, *args, **kwargs)
 
     def merged_params(self, adapters):
         """The deployable full-model params: ``W + (alpha/r)·A·B`` over

@@ -90,6 +90,19 @@ PHASES = (
 # is host time the device sits idle through.
 _NON_HOST_EXPOSED_SPANS = ("round", "round.dispatch", "compile")
 
+# Attribution sub-spans nested INSIDE an already-counted host span: the
+# parent's bracket (`round.host_inputs`) contains their wall time, so
+# summing both would double-book the host wall. They exist so `colearn
+# mfu` can split the host-exposed line into named control-plane
+# sub-lines (sampler / churn / slot-assign / slab-build), not to add
+# to the total.
+_SUBSPAN_PREFIXES = ("round.host_inputs.",)
+
+
+def _is_host_exposed(name: str) -> bool:
+    return (name not in _NON_HOST_EXPOSED_SPANS
+            and not name.startswith(_SUBSPAN_PREFIXES))
+
 
 def host_exposed_pct(phase_ms: Dict[str, float], wall_s: float) -> Optional[float]:
     """Fraction of a timed region's wall clock the device sat idle
@@ -105,7 +118,7 @@ def host_exposed_pct(phase_ms: Dict[str, float], wall_s: float) -> Optional[floa
         return None
     host_ms = sum(
         ms for name, ms in (phase_ms or {}).items()
-        if name not in _NON_HOST_EXPOSED_SPANS
+        if _is_host_exposed(name)
     )
     return 100.0 * (host_ms / 1000.0) / float(wall_s)
 
@@ -182,18 +195,34 @@ COHORT_LAYOUTS = ("spatial", "megabatch")
 
 
 def layout_gemm_rows(cohort_layout: str, clients_per_lane: int,
-                     batch: int) -> int:
+                     batch: int, lora_all_steps: bool = False) -> int:
     """The M rows a shared-weight train-step GEMM feeds the MXU under a
     cohort layout. ``spatial`` trains clients as separate (or batched)
     per-client GEMMs — batched dot dimensions do NOT merge into M, so
     every GEMM's rows are ONE client's batch regardless of
     ``client_vmap_width``; that cap is exactly why the layout, not the
     width, is the structural lever. ``megabatch`` flattens the lane's
-    whole client chunk into the row axis: M = K_local·batch."""
+    whole client chunk into the row axis: M = K_local·batch.
+
+    ``lora_all_steps``: megabatch × frozen-base LoRA via the decomposed
+    apply (models/lora.py ``apply_decomposed``). The row count is the
+    same M = K_local·batch, but its COVERAGE changes: without the flag
+    the un-batched-weight GEMMs exist only in the shared-weight step-0
+    phase (params diverge from step 1 and every base GEMM re-batches);
+    with it the frozen base contracts the flattened megabatch in EVERY
+    local step — only the rank-r adapter factors batch. Spatial has no
+    decomposed path, so the pairing is rejected rather than silently
+    annotated."""
     if cohort_layout not in COHORT_LAYOUTS:
         raise ValueError(
             f"unknown cohort_layout {cohort_layout!r}; "
             f"allowed: {', '.join(COHORT_LAYOUTS)}"
+        )
+    if lora_all_steps and cohort_layout != "megabatch":
+        raise ValueError(
+            "lora_all_steps GEMM geometry exists only under "
+            "cohort_layout='megabatch' (the decomposed LoRA apply is a "
+            "megabatch-layout optimization)"
         )
     if cohort_layout == "megabatch":
         return int(clients_per_lane) * int(batch)
@@ -475,8 +504,19 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     n_chips = int(model.get("n_chips", 1))
     host_ms = sum(
         ms for name, ms in span_ms.items()
-        if name not in _NON_HOST_EXPOSED_SPANS
+        if _is_host_exposed(name)
     ) / max(1, rounds)
+    # control-plane attribution: the named children of the host-input
+    # span (sampler / churn / slot-assign / slab-build), per round —
+    # excluded from the host_exposed SUM above (their parent bracket
+    # already holds their wall), surfaced here as waterfall sub-lines
+    host_sub_ms = {}
+    for name in sorted(span_ms):
+        for pref in _SUBSPAN_PREFIXES:
+            if name.startswith(pref):
+                host_sub_ms[name[len(pref):]] = (
+                    span_ms[name] / max(1, rounds)
+                )
     rps_mean = sum(rps) / len(rps)
     wf = waterfall(
         costs, rps_mean, peak, n_chips=n_chips,
@@ -508,12 +548,14 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "identity_violations": check_waterfall_identity(wf),
         "roofline": roofline,
         "host_exposed_ms_per_round": host_ms,
+        "host_exposed_sub_ms_per_round": host_sub_ms,
         # cohort-layout attribution (runs predating the layout fields
         # render n/a — never a KeyError)
         "layout": {
             "cohort_layout": model.get("cohort_layout"),
             "clients_per_lane": model.get("clients_per_lane"),
             "gemm_rows": model.get("gemm_rows"),
+            "lora_all_steps": model.get("lora_all_steps"),
             "mxu_tile_pad_fraction": model.get("mxu_tile_pad_fraction"),
         },
     }
@@ -544,20 +586,35 @@ def format_mfu_report(report: Dict[str, Any], path: str = "") -> str:
     lay = report.get("layout") or {}
     if lay.get("cohort_layout"):
         pad = lay.get("mxu_tile_pad_fraction")
+        rows_note = (
+            " all steps (lora decomposed)" if lay.get("lora_all_steps")
+            else ""
+        )
         lines.append(
             f"cohort layout: {lay['cohort_layout']}  "
             f"(K_local {_na(lay.get('clients_per_lane'))}, "
-            f"gemm rows {_na(lay.get('gemm_rows'))}, "
+            f"gemm rows {_na(lay.get('gemm_rows'))}{rows_note}, "
             f"mxu row-tile padding "
             f"{_na(None if pad is None else 100.0 * pad, '{:.1f}%')})"
         )
     lines.append("")
     lines.append(f"waterfall (% of wall time, sums to 100 "
                  f"± {WATERFALL_TOL_PCT}):")
+    subs = report.get("host_exposed_sub_ms_per_round") or {}
+    wall_ms = wf["wall_ms_per_round"]
     for name in WATERFALL_COMPONENTS:
         lines.append(
             f"  {_WF_LABELS[name]:<30}{wf['components'][name]:>8.2f}%"
         )
+        if name == "host_exposed" and subs:
+            # control-plane split of the line above (span children of
+            # round.host_inputs — attribution, not additional time)
+            for sub in sorted(subs):
+                pct = (100.0 * (subs[sub] / wall_ms)) if wall_ms else 0.0
+                lines.append(
+                    f"    · {sub:<26}{pct:>8.2f}%"
+                    f"  ({subs[sub]:.3f} ms/round)"
+                )
     for v in report["identity_violations"]:
         lines.append(f"  WARNING: {v}")
     roof = report.get("roofline") or {}
@@ -639,6 +696,9 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
                 "client_updates_per_sec_per_chip"
             ),
             "cohort_layout": extra.get("cohort_layout"),
+            # control-plane mode (run.control_plane, ISSUE 18): entries
+            # predating the knob (r01–r05) render n/a
+            "control_plane": extra.get("control_plane"),
             "host_exposed_pct": extra.get("host_exposed_pct"),
             "weak_scale": _tail_weak_scale_records(doc, parsed),
             "async_throughput": _tail_async_records(doc, parsed),
@@ -922,7 +982,7 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
     lines.append(
         f"{'entry':<18}{'r/s':>8}{'vs_base':>9}{'mfu%':>8}"
         f"{'basis':>11}{'dtype':>10}{'dev ms':>8}"
-        f"{'chips':>7}{'upd/s/chip':>12}{'host%':>7}"
+        f"{'chips':>7}{'upd/s/chip':>12}{'host%':>7}{'mode':>8}"
     )
     for e in entries:
         lines.append(
@@ -936,6 +996,7 @@ def format_bench_report(report: Dict[str, Any], bench_dir: str = "") -> str:
             f"{_na(e.get('n_chips')):>7}"
             f"{_na(e.get('updates_per_sec_per_chip'), '{:.1f}'):>12}"
             f"{_na(e.get('host_exposed_pct'), '{:.1f}'):>7}"
+            f"{_na(e.get('control_plane')):>8}"
         )
     latest = report.get("latest")
     phases = (latest or {}).get("phase_ms_per_round")

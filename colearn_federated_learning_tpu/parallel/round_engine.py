@@ -1836,6 +1836,97 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     return round_fn
 
 
+def make_device_round_fn(round_fn, schedule_fn, fuse, *, client_ledger=False,
+                         data_sharding=None, cohort_sharding=None,
+                         client_sharding=None, fused_cohort_sharding=None,
+                         fused_client_sharding=None, donate=True):
+    """Wrap a (donate-free) sharded engine with the device-resident
+    control plane (``run.control_plane="device"``, server/device_plane):
+    the [K] cohort ids, [K, steps, batch] index slab, [K, 2] spec,
+    weights, and churn realization all derive IN-PROGRAM from
+    ``schedule_fn(arrays, round_idx)`` — the host ships only the static
+    plan tables (once) and a round index per dispatch.
+
+    ``round_fn`` must be built with ``donate=False``: donation moves to
+    this outer jit (params/opt, plus the ledger when present), since the
+    inner engine's buffers are now program-internal values.
+
+    Under ``fuse > 1`` the schedule derivation is vmapped over the
+    chunk's round vector and feeds the engine's fused lax.scan directly
+    — each sub-round's cohort and gates materialize inside the scan
+    body's program, so host I/O collapses to flush boundaries.
+
+    Returns ``(params, opt[, ledger], metrics, sched)`` where ``sched``
+    is the realized schedule WITHOUT the index slab (cohort / spec /
+    n_ex / churn-stat scalars; [F]-stacked under fuse) — fetched at
+    flush so telemetry, digests, and parity pins see exactly what the
+    program executed."""
+    _sched_out = ("cohort", "spec", "n_ex",
+                  "unavailable", "dropped", "crashed")
+
+    def _constrain(x, sharding):
+        if sharding is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    def _rng_rows(rng_key, rounds):
+        # the same per-round keys the host loop derives: fold_in per
+        # round, normalized to raw uint32 rows iff the key is typed
+        # (a restored checkpoint's rng_key comes back typed) — the
+        # fused scan body consumes raw key data, identical bits
+        rngs = jax.vmap(lambda r: jax.random.fold_in(rng_key, r))(rounds)
+        if jax.dtypes.issubdtype(rngs.dtype, jax.dtypes.prng_key):
+            rngs = jax.random.key_data(rngs)
+        return rngs
+
+    _dev_donate = (0, 1) + ((7,) if client_ledger else ())
+
+    if fuse > 1:
+
+        @partial(jax.jit, donate_argnums=_dev_donate if donate else ())
+        def device_round_fn(params, server_opt_state, train_x, train_y,
+                            arrays, round0, rng_key, ledger=None):
+            rounds = round0.astype(jnp.int32) + jnp.arange(
+                fuse, dtype=jnp.int32
+            )
+            with jax.named_scope("round_control_plane"):
+                sched = jax.vmap(lambda r: schedule_fn(arrays, r))(rounds)
+            idx_f = _constrain(sched["idx"], fused_cohort_sharding)
+            spec_f = _constrain(sched["spec"], fused_client_sharding)
+            n_ex_f = _constrain(sched["n_ex"], fused_client_sharding)
+            rngs = _rng_rows(rng_key, rounds)
+            tail = ()
+            if client_ledger:
+                tail = (ledger, _constrain(sched["cohort"], data_sharding))
+            out = round_fn(params, server_opt_state, train_x, train_y,
+                           idx_f, spec_f, n_ex_f, rngs, None, *tail)
+            return out + ({k: sched[k] for k in _sched_out},)
+
+        return device_round_fn
+
+    @partial(jax.jit, donate_argnums=_dev_donate if donate else ())
+    def device_round_fn(params, server_opt_state, train_x, train_y,
+                        arrays, round_idx, rng_key, ledger=None):
+        with jax.named_scope("round_control_plane"):
+            sched = schedule_fn(arrays, round_idx.astype(jnp.int32))
+        idx = _constrain(sched["idx"], cohort_sharding)
+        spec = _constrain(sched["spec"], client_sharding)
+        n_ex = _constrain(sched["n_ex"], client_sharding)
+        rng = jax.random.fold_in(rng_key, round_idx)
+        tail = ()
+        if client_ledger:
+            # in-program ledger slot assignment: the dense store's slot
+            # ids ARE the cohort ids (validate rejects the paged hot
+            # set under device mode), so the _ledger_slot_ids host
+            # remap vanishes from the hot path
+            tail = (ledger, _constrain(sched["cohort"], data_sharding))
+        out = round_fn(params, server_opt_state, train_x, train_y,
+                       idx, spec, n_ex, rng, None, *tail)
+        return out + ({k: sched[k] for k in _sched_out},)
+
+    return device_round_fn
+
+
 def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                         buffer_size: int, window: int, donate: bool = True,
                         client_vmap_width: int = 1, local_dtype=None,

@@ -61,6 +61,7 @@ _TAG_CRASH = np.uint64(0x27D4EB2F165667C5)
 _TAG_FRAC = np.uint64(0x85EBCA6B2C2B2AE3)
 _TAG_ROW = np.uint64(0xD6E8FEB86659FD93)   # client → trace-row mapping
 _TAG_EDGE = np.uint64(0xA0761D6478BD642F)  # per-(round, edge) crash draw
+_TAG_ORDER = np.uint64(0x2545F4914F6CDD1D)  # device-plane shard rotation
 
 _TWO_PI = 2.0 * np.pi
 
@@ -77,19 +78,50 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
         return (x ^ (x >> np.uint64(31))).astype(np.uint64)
 
 
-def _hash01(seed: int, tag: np.uint64, round_idx: int,
-            ids: np.ndarray) -> np.ndarray:
-    """Uniform [0, 1) draw per id, pure in (seed, tag, round, id):
-    three chained SplitMix64 rounds over the packed key — enough
-    avalanche that adjacent (round, id) pairs are independent to the
-    53-bit double precision the [0,1) map keeps."""
+def hash_u64(seed: int, tag: np.uint64, round_idx: int,
+             ids: np.ndarray) -> np.ndarray:
+    """Raw uint64 hash per id, pure in (seed, tag, round, id): three
+    chained SplitMix64 rounds over the packed key. This is the shared
+    host/device draw core — ``server/device_plane.py`` lowers exactly
+    this chain as uint32 pairs and is test-pinned bitwise against it,
+    so every in-program churn gate agrees with the host oracle."""
     ids64 = np.asarray(ids, dtype=np.int64).astype(np.uint64)
     with np.errstate(over="ignore"):
         h = _splitmix64(np.uint64(np.uint64(seed & 0xFFFFFFFFFFFFFFFF)) ^ tag)
         h = _splitmix64(h + np.uint64(round_idx & 0xFFFFFFFFFFFFFFFF))
         h = _splitmix64(h ^ _splitmix64(ids64))
+    return h
+
+
+def hash_k53(seed: int, tag: np.uint64, round_idx: int,
+             ids: np.ndarray) -> np.ndarray:
+    """Top 53 hash bits as uint64 — the integer the float draw is built
+    from. ``hash_k53(...) < ceil(p * 2**53)`` is exactly equivalent to
+    ``_hash01(...) < p`` for p in [0, 1] (p * 2**53 is exact in
+    float64, so the ceiling is the true integer threshold), which is
+    how the device plane evaluates probability gates without floats."""
+    return hash_u64(seed, tag, round_idx, ids) >> np.uint64(11)
+
+
+def threshold_u53(p) -> np.ndarray:
+    """ceil(p * 2**53) clipped to [0, 2**53] as uint64: the integer
+    threshold equivalent of comparing the 53-bit draw against float
+    probability ``p`` (see ``hash_k53``)."""
+    p = np.clip(np.asarray(p, dtype=np.float64), 0.0, 1.0)
+    # p * 2**53 is exact in float64 for p in [0, 1] (exponent shift of
+    # a <=53-bit significand), so ceil is the exact integer threshold
+    return np.ceil(p * float(1 << 53)).astype(np.uint64)
+
+
+def _hash01(seed: int, tag: np.uint64, round_idx: int,
+            ids: np.ndarray) -> np.ndarray:
+    """Uniform [0, 1) draw per id, pure in (seed, tag, round, id) —
+    the top 53 bits of ``hash_u64`` mapped to float64 (enough avalanche
+    that adjacent (round, id) pairs are independent to the 53-bit
+    double precision the [0,1) map keeps)."""
     # top 53 bits → [0, 1) exactly representable in float64
-    return (h >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+    return (hash_k53(seed, tag, round_idx, ids)).astype(np.float64) \
+        / float(1 << 53)
 
 
 class ChurnModel:

@@ -1,0 +1,452 @@
+"""Device-resident control plane (``run.control_plane = "device"``).
+
+ROADMAP item 4's residue: with the corpus HBM-resident and the round
+program fused, the host still runs sampler draws, churn gating, slab
+construction, and ledger slot assignment in Python between dispatches
+— the control plane is host-exposed even though ``server/churn.py``'s
+counter-mode SplitMix64 discipline is already a pure function of
+(seed, round, id). This module lowers that control plane into the
+round program itself:
+
+- **Cohort ids** come from a tiny precomputed ``[num_rounds, K]``
+  int32 table, built ONCE at driver init by running the unmodified
+  host sampler over every round — so the device-mode cohorts are
+  bitwise-equal to host mode by construction (the PCG64 ``rng.choice``
+  draw is not XLA-lowerable; a 4-byte/round-slot table is, and it
+  costs less wire than one round's index slab).
+- **Churn gates** (availability / dropout hazard / crash) are evaluated
+  in-program by a uint32-pair lowering of the SAME SplitMix64 chain
+  ``churn.hash_u64`` computes on host. Probability gates compare the
+  top-53-bit integer draw against ``ceil(p * 2**53)`` thresholds —
+  exactly equivalent to the host's ``float64 u < p`` compare (``p *
+  2**53`` is exact in float64 for p in [0, 1]), so realized
+  availability/drop/crash bits are bitwise-equal to ``ChurnModel``.
+  The diurnal probability itself involves ``np.sin``, which has no
+  bitwise XLA twin — so the thresholds are precomputed on host as a
+  ``[num_rounds, N]`` uint64 table (uint32 pairs on device), gathered
+  per (round, id) in-program. ``config.validate`` bounds the table.
+- **The index slab** is derived in-program from a device-resident
+  padded shard table: epoch ``e`` of round ``r`` reads client ``c``'s
+  shard rotated by ``hash_u64(seed, ORDER, r, c*E + e) % len(c)`` —
+  a seed-pure rotation that (a) preserves the contiguous-head padding
+  invariant the engines' mask-spec reconstruction relies on and (b)
+  covers every example of every shard across rounds. This is a
+  DIFFERENT (documented) data order than the host path's PCG64
+  shuffle: cohorts, churn gates, specs, and weights are bitwise-equal
+  to host mode, but per-batch example composition is the device
+  plane's own discipline — ``reference_schedule`` below is its exact
+  NumPy twin and the parity oracle the jnp program is pinned against.
+- **Crash work fractions** use the shared integer formula ``done =
+  max(1, ((2**53 - k53) * steps) >> 53)`` in both the NumPy reference
+  and the jnp program (the host float path ``floor(frac * steps)``
+  can differ from it only when float64 rounding crosses an integer
+  boundary — probability ~2**-43 per draw; the realized *crash bit*
+  is always bitwise-equal).
+
+Everything here is pure in (seed, round): resume from any checkpoint
+re-derives the identical schedule with zero checkpoint state, and the
+fused scan body can derive each sub-round's schedule itself so host
+I/O collapses to flush boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from colearn_federated_learning_tpu.server.churn import (
+    _TAG_AVAIL,
+    _TAG_CRASH,
+    _TAG_DROP,
+    _TAG_FRAC,
+    _TAG_ORDER,
+    ChurnModel,
+    hash_k53,
+    hash_u64,
+    threshold_u53,
+)
+
+_MASK32 = 0xFFFFFFFF
+_U64 = np.uint64
+
+
+# ---------------------------------------------------------------------------
+# plan: everything static the device program needs, built once at init
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DevicePlan:
+    """Static schedule inputs for the device control plane. Arrays are
+    host NumPy; the driver ships them to HBM once (uint64 tables as
+    uint32 pairs — XLA has no uint64 on the default build). Total
+    footprint: ``R*K + N*Lmax + 3N`` int32 plus ``2*R*N`` uint32 when
+    churn is on (bounded by config.validate)."""
+
+    seed: int
+    num_clients: int
+    cohort: int
+    num_rounds: int
+    local_epochs: int
+    steps_per_epoch: int
+    batch: int
+    steps: int
+    cap: int
+    churn: bool
+    dropout_thr: int  # ceil(p * 2**53) integer gate thresholds
+    crash_thr: int
+    cohort_table: np.ndarray  # [R, K] int32
+    shard_table: np.ndarray   # [N, Lmax] int32, rows zero-padded
+    shard_len: np.ndarray     # [N] int32
+    take: np.ndarray          # [N] int32 = min(len, cap)
+    avail_thr: Optional[np.ndarray]  # [R, N] uint64 (None without churn)
+
+
+def build_device_plan(fed, shape, sample_fn: Callable[[int], np.ndarray],
+                      churn: Optional[ChurnModel], seed: int,
+                      num_rounds: int) -> DevicePlan:
+    """Build the device plan: run the (unmodified) host sampler over
+    every round for the cohort table, pad the shard index lists into
+    one gatherable matrix, and precompute the churn availability
+    thresholds. Pure in (seed, config) — rebuilt identically on
+    resume."""
+    n = int(fed.num_clients)
+    steps = int(shape.steps)
+    if steps > 2048:
+        raise ValueError(
+            f"control_plane='device' supports steps <= 2048 (crash "
+            f"fraction fixed-point bound), got {steps}"
+        )
+    if n * shape.local_epochs >= 1 << 31:
+        raise ValueError(
+            "control_plane='device': num_clients * local_epochs must "
+            "fit int32 for the rotation hash key"
+        )
+    cohorts = np.stack([
+        np.asarray(sample_fn(r), np.int64) for r in range(num_rounds)
+    ])
+    if cohorts.size and (cohorts.min() < 0 or cohorts.max() >= n):
+        raise ValueError(
+            "control_plane='device' requires cohort ids in [0, "
+            f"num_clients); sampler drew outside [0, {n})"
+        )
+    shards = [np.asarray(fed.client_indices[c], np.int64) for c in range(n)]
+    lens = np.array([len(s) for s in shards], np.int64)
+    if (lens < 1).any():
+        raise ValueError(
+            "control_plane='device' requires non-empty client shards "
+            "(rotation is modulo the shard length)"
+        )
+    lmax = int(lens.max())
+    shard_table = np.zeros((n, lmax), np.int32)
+    for c, s in enumerate(shards):
+        shard_table[c, : len(s)] = s.astype(np.int32)
+    take = np.minimum(lens, int(shape.cap)).astype(np.int32)
+    avail_thr = None
+    dropout_thr = crash_thr = 0
+    if churn is not None:
+        ids = np.arange(n, dtype=np.int64)
+        avail_thr = np.stack([
+            threshold_u53(churn.availability_prob(r, ids))
+            for r in range(num_rounds)
+        ])
+        dropout_thr = int(threshold_u53(churn.dropout_hazard))
+        crash_thr = int(threshold_u53(churn.crash_rate))
+    return DevicePlan(
+        seed=int(seed), num_clients=n, cohort=int(cohorts.shape[1]),
+        num_rounds=int(num_rounds), local_epochs=int(shape.local_epochs),
+        steps_per_epoch=int(shape.steps_per_epoch),
+        batch=int(shape.batch_size), steps=steps, cap=int(shape.cap),
+        churn=churn is not None, dropout_thr=dropout_thr,
+        crash_thr=crash_thr, cohort_table=cohorts.astype(np.int32),
+        shard_table=shard_table, shard_len=lens.astype(np.int32),
+        take=take, avail_thr=avail_thr,
+    )
+
+
+def plan_arrays(plan: DevicePlan) -> Dict[str, np.ndarray]:
+    """The plan's device-resident tensors, uint64 tables split into
+    (hi, lo) uint32 pairs. The driver device_puts this dict once."""
+    arrs = {
+        "cohort_table": plan.cohort_table,
+        "shard_table": plan.shard_table,
+        "shard_len": plan.shard_len,
+        "take": plan.take,
+    }
+    if plan.avail_thr is not None:
+        arrs["avail_hi"] = (plan.avail_thr >> _U64(32)).astype(np.uint32)
+        arrs["avail_lo"] = (plan.avail_thr & _U64(_MASK32)).astype(np.uint32)
+    return arrs
+
+
+# ---------------------------------------------------------------------------
+# shared integer disciplines (NumPy side)
+# ---------------------------------------------------------------------------
+
+
+def crash_done_steps(k_frac: np.ndarray, steps: int) -> np.ndarray:
+    """Steps completed before a crash, from the raw 53-bit fraction
+    draw: ``max(1, ((2**53 - k53) * steps) >> 53)`` — pure integer
+    math, shared verbatim (as a uint32-pair program) by the device
+    twin. ``steps <= 2048`` keeps the product inside uint64."""
+    k = np.asarray(k_frac, _U64)
+    with np.errstate(over="ignore"):
+        m = _U64(1 << 53) - k
+        done = (m * _U64(steps)) >> _U64(53)
+    return np.maximum(_U64(1), done).astype(np.int64)
+
+
+def _rotation_offsets(seed: int, round_idx: int, cohort: np.ndarray,
+                      epochs: int, lens: np.ndarray) -> np.ndarray:
+    """[K, E] rotation offset per (cohort member, epoch): low 32 hash
+    bits mod the shard length (uint32 modulo — the device twin's
+    native width)."""
+    keys = (cohort.astype(np.int64)[:, None] * epochs
+            + np.arange(epochs, dtype=np.int64)[None, :])
+    h = hash_u64(seed, _TAG_ORDER, round_idx, keys.reshape(-1))
+    lo = (h & _U64(_MASK32)).reshape(len(cohort), epochs)
+    return (lo % lens.astype(_U64)[:, None]).astype(np.int64)
+
+
+def reference_schedule(plan: DevicePlan, round_idx: int) -> Dict[str, np.ndarray]:
+    """Exact NumPy twin of the in-program schedule derivation — the
+    parity oracle ``device_schedule`` is test-pinned against, and the
+    host-side schedule source when the driver needs one under device
+    mode (unfused catch-up, tests). Returns cohort [K] i32, idx
+    [K, steps, batch] i32, spec [K, 2] i32, n_ex [K] f32, and the
+    realized churn stats (unavailable / dropped / crashed counts)."""
+    r = int(round_idx)
+    k = plan.cohort
+    epochs, spe, batch = plan.local_epochs, plan.steps_per_epoch, plan.batch
+    per_epoch = spe * batch
+    cohort = plan.cohort_table[r].astype(np.int64)
+    take = plan.take[cohort].astype(np.int64)
+    lens = plan.shard_len[cohort].astype(np.int64)
+
+    # -- churn gates (bitwise == ChurnModel via integer thresholds) --
+    offline = np.zeros(k, bool)
+    hazard = np.zeros(k, bool)
+    crashed = np.zeros(k, bool)
+    vsteps = np.full(k, plan.steps, np.int64)
+    if plan.churn:
+        offline = ~(hash_k53(plan.seed, _TAG_AVAIL, r, cohort)
+                    < plan.avail_thr[r, cohort])
+        hazard = hash_k53(plan.seed, _TAG_DROP, r, cohort) \
+            < _U64(plan.dropout_thr)
+        crashed = hash_k53(plan.seed, _TAG_CRASH, r, cohort) \
+            < _U64(plan.crash_thr)
+        if crashed.any():
+            done = crash_done_steps(
+                hash_k53(plan.seed, _TAG_FRAC, r, cohort), plan.steps
+            )
+            vsteps = np.where(crashed, np.minimum(vsteps, done), vsteps)
+
+    # -- spec + weights (host closed form, integer math) --
+    spec = np.stack([take, vsteps], axis=1).astype(np.int32)
+    total = np.zeros(k, np.int64)
+    for e in range(epochs):
+        avail = np.clip(vsteps - e * spe, 0, spe)
+        total += np.minimum(take, avail * batch)
+    n_ex = np.where(offline | hazard, 0.0, total.astype(np.float32))
+    n_ex = n_ex.astype(np.float32)
+
+    # -- index slab: rotated shard reads, contiguous-head padding --
+    off = _rotation_offsets(plan.seed, r, cohort, epochs, lens)
+    pos = np.arange(per_epoch, dtype=np.int64)
+    col = (off[:, :, None] + pos[None, None, :]) % lens[:, None, None]
+    vals = plan.shard_table[cohort[:, None, None], col]
+    idx = np.where(pos[None, None, :] < take[:, None, None], vals, 0)
+    idx = idx.astype(np.int32).reshape(k, plan.steps, batch)
+
+    return {
+        "cohort": cohort.astype(np.int32),
+        "idx": idx,
+        "spec": spec,
+        "n_ex": n_ex,
+        "unavailable": int(offline.sum()),
+        "dropped": int((hazard & ~offline).sum()),
+        "crashed": int(crashed.sum()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# uint32-pair SplitMix64 (jnp lowering of churn.hash_u64)
+# ---------------------------------------------------------------------------
+#
+# XLA's default build has no uint64, so the 64-bit hash chain runs as
+# (hi, lo) uint32 pairs: wide 32x32 multiply via 16-bit limbs, add with
+# carry, cross-pair shifts. Pinned bitwise against churn.hash_u64 by
+# tests/test_device_plane.py.
+
+
+def _pair_const(c, jnp):
+    c = int(c) & 0xFFFFFFFFFFFFFFFF
+    return jnp.uint32(c >> 32), jnp.uint32(c & _MASK32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(lo.dtype)
+    return ah + bh + carry, lo
+
+
+def _sub64(ah, al, bh, bl):
+    lo = al - bl
+    borrow = (al < bl).astype(al.dtype)
+    return ah - bh - borrow, lo
+
+
+def _mul32_wide(a, b):
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & 0xFFFF) + (p10 & 0xFFFF)
+    lo = (p00 & 0xFFFF) | ((mid & 0xFFFF) << 16)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _mul64(ah, al, bh, bl):
+    # (ah*2^32 + al) * (bh*2^32 + bl) mod 2^64
+    hi, lo = _mul32_wide(al, bl)
+    return hi + al * bh + ah * bl, lo
+
+
+def _shr64(h, l, n: int):
+    # 0 < n < 32 (the splitmix shifts are 30 / 27 / 31)
+    return h >> n, (l >> n) | (h << (32 - n))
+
+
+def _splitmix64_pair(h, l, jnp):
+    gh, gl = _pair_const(0x9E3779B97F4A7C15, jnp)
+    h, l = _add64(h, l, gh, gl)
+    xh, xl = _shr64(h, l, 30)
+    h, l = h ^ xh, l ^ xl
+    h, l = _mul64(h, l, *_pair_const(0xBF58476D1CE4E5B9, jnp))
+    xh, xl = _shr64(h, l, 27)
+    h, l = h ^ xh, l ^ xl
+    h, l = _mul64(h, l, *_pair_const(0x94D049BB133111EB, jnp))
+    xh, xl = _shr64(h, l, 31)
+    return h ^ xh, l ^ xl
+
+
+def hash_u64_pair(seed: int, tag, round_idx, ids_lo, jnp):
+    """jnp twin of ``churn.hash_u64`` for non-negative 32-bit ids:
+    ``round_idx`` is a traced uint32 scalar (or [F] vector under the
+    fused vmap), ``ids_lo`` a uint32 array. Returns (hi, lo) uint32."""
+    z = jnp.uint32(0)
+    sh, sl = _pair_const(int(seed) ^ int(tag), jnp)
+    h, l = _splitmix64_pair(
+        jnp.broadcast_to(sh, ()), jnp.broadcast_to(sl, ()), jnp
+    )
+    h, l = _add64(h, l, z, round_idx.astype(jnp.uint32))
+    h, l = _splitmix64_pair(h, l, jnp)
+    ih, il = _splitmix64_pair(
+        jnp.zeros_like(ids_lo), ids_lo.astype(jnp.uint32), jnp
+    )
+    h, l = h ^ ih, l ^ il
+    return _splitmix64_pair(h, l, jnp)
+
+
+def _k53_pair(h, l):
+    # top 53 bits as a (21-bit hi, 32-bit lo) pair: (h:l) >> 11
+    return h >> 11, (l >> 11) | (h << 21)
+
+
+def _lt_pair(ah, al, bh, bl):
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def _k53_lt_const(kh, kl, thr: int, jnp):
+    th = jnp.uint32((int(thr) >> 32) & _MASK32)
+    tl = jnp.uint32(int(thr) & _MASK32)
+    return _lt_pair(kh, kl, th, tl)
+
+
+def make_schedule_fn(plan: DevicePlan):
+    """The in-program schedule derivation: a pure jnp function
+    ``schedule(arrays, round_idx) -> dict`` with the plan's statics
+    closed over. ``round_idx`` is a traced int32 scalar, so ONE
+    compiled program serves every round (and the fused path vmaps it
+    over the chunk's round vector). Output is bitwise-equal to
+    ``reference_schedule`` (test-pinned)."""
+    import jax.numpy as jnp
+
+    seed = plan.seed
+    k = plan.cohort
+    epochs, spe, batch = plan.local_epochs, plan.steps_per_epoch, plan.batch
+    steps, per_epoch = plan.steps, plan.steps_per_epoch * plan.batch
+
+    def schedule(arrays, round_idx):
+        r = round_idx.astype(jnp.int32)
+        ru = r.astype(jnp.uint32)
+        cohort = arrays["cohort_table"][r]  # [K] i32
+        cu = cohort.astype(jnp.uint32)
+        take = arrays["take"][cohort].astype(jnp.int32)
+        lens = arrays["shard_len"][cohort].astype(jnp.uint32)
+
+        offline = jnp.zeros((k,), bool)
+        hazard = jnp.zeros((k,), bool)
+        crashed = jnp.zeros((k,), bool)
+        vsteps = jnp.full((k,), steps, jnp.int32)
+        if plan.churn:
+            ah, al = hash_u64_pair(seed, _TAG_AVAIL, ru, cu, jnp)
+            kh, kl = _k53_pair(ah, al)
+            t_hi = arrays["avail_hi"][r, cohort]
+            t_lo = arrays["avail_lo"][r, cohort]
+            offline = ~_lt_pair(kh, kl, t_hi, t_lo)
+            dh, dl = hash_u64_pair(seed, _TAG_DROP, ru, cu, jnp)
+            hazard = _k53_lt_const(*_k53_pair(dh, dl), plan.dropout_thr, jnp)
+            ch, cl = hash_u64_pair(seed, _TAG_CRASH, ru, cu, jnp)
+            crashed = _k53_lt_const(*_k53_pair(ch, cl), plan.crash_thr, jnp)
+            fh, fl = hash_u64_pair(seed, _TAG_FRAC, ru, cu, jnp)
+            fkh, fkl = _k53_pair(fh, fl)
+            # done = max(1, ((2^53 - k53) * steps) >> 53): the shared
+            # integer crash-fraction discipline (crash_done_steps)
+            mh, ml = _sub64(jnp.full((k,), 1 << 21, jnp.uint32),
+                            jnp.zeros((k,), jnp.uint32), fkh, fkl)
+            ph, _pl = _mul64(mh, ml, jnp.zeros((k,), jnp.uint32),
+                             jnp.full((k,), steps, jnp.uint32))
+            done = jnp.maximum(1, (ph >> 21).astype(jnp.int32))
+            vsteps = jnp.where(crashed, jnp.minimum(vsteps, done), vsteps)
+
+        spec = jnp.stack([take, vsteps], axis=1).astype(jnp.int32)
+        total = jnp.zeros((k,), jnp.int32)
+        for e in range(epochs):
+            avail = jnp.clip(vsteps - e * spe, 0, spe)
+            total = total + jnp.minimum(take, avail * batch)
+        n_ex = jnp.where(offline | hazard, jnp.float32(0.0),
+                         total.astype(jnp.float32))
+
+        # rotation slab: epoch e reads the shard rotated by the
+        # seed-pure per-(round, client, epoch) offset
+        ekeys = (cu[:, None] * jnp.uint32(epochs)
+                 + jnp.arange(epochs, dtype=jnp.uint32)[None, :])
+        oh, ol = hash_u64_pair(seed, _TAG_ORDER, ru, ekeys, jnp)
+        del oh
+        off = ol % lens[:, None]  # [K, E] uint32
+        pos = jnp.arange(per_epoch, dtype=jnp.uint32)
+        col = (off[:, :, None] + pos[None, None, :]) % lens[:, None, None]
+        vals = arrays["shard_table"][cohort[:, None, None],
+                                     col.astype(jnp.int32)]
+        idx = jnp.where(
+            pos.astype(jnp.int32)[None, None, :] < take[:, None, None],
+            vals, 0,
+        ).astype(jnp.int32).reshape(k, steps, batch)
+
+        return {
+            "cohort": cohort,
+            "idx": idx,
+            "spec": spec,
+            "n_ex": n_ex,
+            "unavailable": offline.sum().astype(jnp.int32),
+            "dropped": (hazard & ~offline).sum().astype(jnp.int32),
+            "crashed": crashed.sum().astype(jnp.int32),
+        }
+
+    return schedule

@@ -155,6 +155,14 @@ class Experiment:
         # gossip and fedbuff keep the legacy full-mask inputs (their
         # engines consume it directly).
         self._spec_inputs = cfg.algorithm not in ("gossip", "fedbuff")
+        # Device-resident control plane (run.control_plane="device",
+        # server/device_plane.py): cohort ids, churn gates, the index
+        # slab, and ledger slot ids derive INSIDE the round program —
+        # the host ships static plan tables once and a round index per
+        # dispatch; realized schedules surface at flush boundaries.
+        # validate() restricted the pairing surface (uniform sampling,
+        # hbm placement, sharded/sequential engines, dense ledger).
+        self._cp_device = cfg.run.control_plane == "device"
         # Ledger-driven adaptive selection (server.sampling="adaptive"):
         # the sampler scores clients Oort-style from periodic host-side
         # ledger snapshots — COLUMN-SLIMMED to the three columns it
@@ -492,7 +500,7 @@ class Experiment:
                     rep_z_gain=cfg.server.reputation.z_gain,
                 )
             else:
-                def _make_engine(fuse):
+                def _make_engine(fuse, donate=True):
                     return make_sharded_round_fn(
                         self.model, cfg.client, cfg.dp, self.task, self.mesh,
                         server_update,
@@ -540,15 +548,19 @@ class Experiment:
                         hierarchy=self._hier,
                         # hierarchy re-dispatches the SAME params/opt
                         # buffers once per edge — donation would delete
-                        # them after the first edge's call
-                        donate=not self._hier,
+                        # them after the first edge's call; the device
+                        # control plane moves donation to its outer
+                        # wrapper jit (donate=False here)
+                        donate=donate and not self._hier,
                     )
 
                 self.round_fn = _make_engine(cfg.run.fuse_rounds)
                 # an unfused twin is built lazily (one extra compile)
                 # only when a resume lands off a chunk boundary — see
-                # _unfused_round_fn / the _fit_body catch-up loop
-                if cfg.run.fuse_rounds > 1:
+                # _unfused_round_fn / the _fit_body catch-up loop; the
+                # device control plane keeps the factory for its
+                # donate-free inner engines
+                if cfg.run.fuse_rounds > 1 or self._cp_device:
                     self._make_engine = _make_engine
             self._data_sharding = mesh_lib.replicated(self.mesh)
             self._cohort_sharding = mesh_lib.cohort_sharded(self.mesh)
@@ -678,6 +690,10 @@ class Experiment:
         self._double_buffer = (
             bool(cfg.run.double_buffer) and not self.fedbuff
             and not self._hier
+            # device control plane: there are no host slabs to build
+            # ahead — the worker would race the in-program derivation
+            # for nothing, so double-buffering is structurally off
+            and not self._cp_device
         )
         self._db_stats = {
             "host_prefetched": 0, "placed_prefetched": 0,
@@ -703,6 +719,15 @@ class Experiment:
         else:
             self.train_x = put(jnp.asarray(self.fed.train_x))
             self.train_y = put(jnp.asarray(self.fed.train_y))
+        # Device control plane: build the static plan (cohort table via
+        # the UNMODIFIED host sampler — device cohorts are bitwise-equal
+        # to host mode by construction — churn thresholds, shard table),
+        # ship it to HBM once, and wrap the donate-free engine twins.
+        self._device_plan = None
+        self._device_sched: Dict[int, Any] = {}
+        self._device_draw_stats: Dict[int, Optional[Dict[str, int]]] = {}
+        if self._cp_device:
+            self._init_device_plane()
         eval_fn = make_eval_fn(self.model, self.task)
         self._eval_fn = jax.jit(eval_fn)
 
@@ -851,6 +876,10 @@ class Experiment:
             )
         if (cfg.run.host_pipeline in ("auto", "native")
                 and not self._poisson
+                # the device control plane derives round inputs
+                # in-program — there is no host slab to prefetch
+                # (validate() rejects explicit 'native'; 'auto' skips)
+                and not self._cp_device
                 # bucketed grids vary per round; the C++ pipeline builds
                 # ONE fixed shape (validate() rejects the explicit
                 # 'native' pairing; 'auto' degrades to NumPy here).
@@ -1612,45 +1641,62 @@ class Experiment:
         [K, 2] mask SPEC instead of the full float32 mask.
         ``build_slab=False`` skips the per-round stream slab — the fused
         chunk path gathers ONE union slab over the whole chunk instead."""
-        if self.gossip and self._gossip_partial == 0:
-            # full participation: row i of the round tensors IS client i
-            # (the ring order is the client-id order, every round)
-            cohort = np.arange(self.fed.num_clients, dtype=np.int64)
-        else:
-            # centralized cohorts, or partial-participation gossip's
-            # per-round active subset (uniform without replacement)
-            cohort = self.sampler.sample(round_idx)
+        # named control-plane sub-spans (children of round.host_inputs
+        # in the waterfall — roofline excludes them from host_exposed
+        # totals so nothing double-counts): exactly the work the device
+        # control plane removes, attributable line by line
+        with self.tracer.span("round.host_inputs.sampler"):
+            if self.gossip and self._gossip_partial == 0:
+                # full participation: row i of the round tensors IS
+                # client i (the ring order is the client-id order,
+                # every round)
+                cohort = np.arange(self.fed.num_clients, dtype=np.int64)
+            else:
+                # centralized cohorts, or partial-participation
+                # gossip's per-round active subset (uniform without
+                # replacement)
+                cohort = self.sampler.sample(round_idx)
         if shape is None:
             shape = self._round_shape(round_idx)
         host_rng = np.random.default_rng((self.cfg.run.seed, 7919, round_idx))
-        if self._native is not None:
-            self._native.submit(round_idx, cohort)  # no-op if prefetched
-            # overlap: the NEXT dispatch's tensors build on C++ worker
-            # threads while the device executes this one. Under
-            # run.fuse_rounds > 1 a dispatch consumes a whole chunk, so
-            # the look-ahead window is `fuse` rounds of index slabs per
-            # submit (duplicate submits are no-ops in the pipeline).
-            ahead = max(1, self.cfg.run.fuse_rounds)
-            for j in range(1, ahead + 1):
-                nxt = round_idx + j
-                if nxt < self.cfg.server.num_rounds:
-                    self._native.submit(nxt, self.sampler.sample(nxt))
-            idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
-            if self._spec_inputs:
-                # the pipeline skipped the mask slab (build_mask=False);
-                # the spec is analytic — native packs each epoch's
-                # min(|shard|, cap) real indices contiguously
-                take = self._sizes_capped[np.asarray(cohort)]
-                mask = np.stack(
-                    [take, np.full(len(cohort), shape.steps, np.int64)], 1
-                ).astype(np.int32)
-        elif self._spec_inputs:
-            idx, mask, n_ex = make_round_spec(self.fed, cohort, shape, host_rng)
-        else:
-            idx, mask, n_ex = make_round_indices(self.fed, cohort, shape, host_rng)
-        mask, n_ex = self._apply_failures(mask, n_ex, len(cohort), host_rng,
-                                          round_idx=round_idx, shape=shape,
-                                          cohort=cohort)
+        with self.tracer.span("round.host_inputs.slab_build"):
+            if self._native is not None:
+                self._native.submit(round_idx, cohort)  # no-op if prefetched
+                # overlap: the NEXT dispatch's tensors build on C++
+                # worker threads while the device executes this one.
+                # Under run.fuse_rounds > 1 a dispatch consumes a whole
+                # chunk, so the look-ahead window is `fuse` rounds of
+                # index slabs per submit (duplicate submits are no-ops
+                # in the pipeline).
+                ahead = max(1, self.cfg.run.fuse_rounds)
+                for j in range(1, ahead + 1):
+                    nxt = round_idx + j
+                    if nxt < self.cfg.server.num_rounds:
+                        self._native.submit(nxt, self.sampler.sample(nxt))
+                idx, mask, n_ex = self._native.fetch(round_idx, len(cohort))
+                if self._spec_inputs:
+                    # the pipeline skipped the mask slab
+                    # (build_mask=False); the spec is analytic — native
+                    # packs each epoch's min(|shard|, cap) real indices
+                    # contiguously
+                    take = self._sizes_capped[np.asarray(cohort)]
+                    mask = np.stack(
+                        [take, np.full(len(cohort), shape.steps, np.int64)],
+                        1,
+                    ).astype(np.int32)
+            elif self._spec_inputs:
+                idx, mask, n_ex = make_round_spec(
+                    self.fed, cohort, shape, host_rng
+                )
+            else:
+                idx, mask, n_ex = make_round_indices(
+                    self.fed, cohort, shape, host_rng
+                )
+        with self.tracer.span("round.host_inputs.churn"):
+            mask, n_ex = self._apply_failures(
+                mask, n_ex, len(cohort), host_rng, round_idx=round_idx,
+                shape=shape, cohort=cohort,
+            )
         if self._poisson:
             cap, b = self._poisson_cap, len(cohort)
             if b > cap:
@@ -2551,6 +2597,221 @@ class Experiment:
             self._unfused_cache = self._make_engine(1)
         return self._unfused_cache
 
+    # ---- device-resident control plane (run.control_plane="device") --
+
+    def _init_device_plane(self) -> None:
+        """Build the device control plane (server/device_plane.py): the
+        cohort table runs the UNCHANGED host sampler over every round
+        (so device cohorts are bitwise-equal to host mode by
+        construction), churn thresholds precompute the diurnal curve as
+        integer gates, and the shard table makes the index slab a pure
+        in-program gather. Draw-provenance tallies are captured here
+        per round (the sampler bounds its unconsumed backlog) and
+        consumed by the flush drain's population feed."""
+        from colearn_federated_learning_tpu.server.device_plane import (
+            build_device_plan,
+            make_schedule_fn,
+            plan_arrays,
+        )
+
+        cfg = self.cfg
+
+        def _sample(r):
+            out = self.sampler.sample(r)
+            self._device_draw_stats[r] = self.sampler.take_draw_stats(r)
+            return out
+
+        self._device_plan = build_device_plan(
+            self.fed, self.shape, _sample, self._churn,
+            cfg.run.seed, cfg.server.num_rounds,
+        )
+        arrs = plan_arrays(self._device_plan)
+        if self._data_sharding is not None:
+            self._device_arrays = {
+                k: self._put(jnp.asarray(v), self._data_sharding)
+                for k, v in arrs.items()
+            }
+        else:
+            self._device_arrays = {
+                k: jnp.asarray(v) for k, v in arrs.items()
+            }
+        self._schedule_fn = make_schedule_fn(self._device_plan)
+        self._device_unfused_cache = None
+        if self.mesh is not None:
+            self._device_round_fn = self._build_device_round_fn(
+                cfg.run.fuse_rounds
+            )
+        else:
+            # sequential oracle: the jitted schedule derivation runs on
+            # device and its fetched outputs feed the python-loop
+            # engine — the oracle pins schedule/params parity, not
+            # wall-clock
+            self._device_schedule_jit = jax.jit(self._schedule_fn)
+
+    def _build_device_round_fn(self, fuse: int):
+        from colearn_federated_learning_tpu.parallel.round_engine import (
+            make_device_round_fn,
+        )
+
+        return make_device_round_fn(
+            self._make_engine(fuse, donate=False), self._schedule_fn,
+            fuse, client_ledger=self._ledger_on,
+            data_sharding=self._data_sharding,
+            cohort_sharding=self._cohort_sharding,
+            client_sharding=self._client_sharding,
+            fused_cohort_sharding=self._fused_cohort_sharding,
+            fused_client_sharding=self._fused_client_sharding,
+        )
+
+    def _device_unfused_round_fn(self):
+        """The fuse=1 device-wrapper twin, built lazily for unaligned-
+        resume catch-up rounds (mirrors _unfused_round_fn)."""
+        if self._device_unfused_cache is None:
+            self._device_unfused_cache = self._build_device_round_fn(1)
+        return self._device_unfused_cache
+
+    def _note_device_sched(self, round_idx: int, fuse: int,
+                           sched: Dict[str, Any]) -> None:
+        """Keep device handles of the realized schedule (WITHOUT the
+        index slab — cohort/spec/weights/churn scalars only) for the
+        flush-boundary drain. Under fuse the [F]-stacked outputs are
+        held as per-sub-round device slices, like pending metrics."""
+        sched = {k: v for k, v in sched.items() if k != "idx"}
+        if fuse > 1:
+            for j in range(fuse):
+                self._device_sched[round_idx + j] = jax.tree.map(
+                    lambda a, j=j: a[j], sched
+                )
+        else:
+            self._device_sched[round_idx] = sched
+
+    def _run_device_round(self, state: Dict[str, Any], round_idx: int,
+                          fuse: int) -> Dict[str, Any]:
+        """One device-control-plane dispatch: the round program derives
+        its own cohort, churn gates, and index slab from (seed, round)
+        — the host passes a round index. Under fuse>1 the scan body
+        derives each sub-round's schedule itself, so host I/O collapses
+        to flush boundaries."""
+        if self.mesh is None:
+            return self._run_device_round_seq(state, round_idx)
+        if fuse == self.cfg.run.fuse_rounds:
+            round_fn = self._device_round_fn
+        else:
+            round_fn = self._device_unfused_round_fn()
+        args = (state["params"], state["server_opt_state"],
+                self.train_x, self.train_y, self._device_arrays,
+                jnp.int32(round_idx), state["rng_key"])
+        with self.tracer.span("round.dispatch"):
+            if self._ledger_on:
+                params, opt_state, ledger, metrics, sched = round_fn(
+                    *args, state["ledger"]
+                )
+            else:
+                params, opt_state, metrics, sched = round_fn(*args)
+        self._note_device_sched(round_idx, fuse, sched)
+        new_state = {
+            "params": params,
+            "server_opt_state": opt_state,
+            "round": round_idx + fuse,
+            "rng_key": state["rng_key"],
+            "_metrics": metrics,
+        }
+        if self._ledger_on:
+            new_state["ledger"] = ledger
+        return new_state
+
+    def _run_device_round_seq(self, state: Dict[str, Any],
+                              round_idx: int) -> Dict[str, Any]:
+        """Sequential-engine device mode: the schedule still derives
+        on device (the jitted schedule program — host_inputs is one
+        fetch, no sampler/churn/slab python), then feeds the unchanged
+        per-client oracle loop."""
+        with self.tracer.span("round.host_inputs"):
+            sched = jax.device_get(self._device_schedule_jit(
+                self._device_arrays, jnp.int32(round_idx)
+            ))
+        self._note_device_sched(round_idx, 1, sched)
+        rng = jax.random.fold_in(state["rng_key"], round_idx)
+        kw = {}
+        if self._ledger_on:
+            kw = dict(
+                ledger=state["ledger"],
+                ledger_ids=jnp.asarray(
+                    np.asarray(sched["cohort"], np.int32)
+                ),
+            )
+        with self.tracer.span("round.dispatch"):
+            out = self.round_fn(
+                state["params"], state["server_opt_state"],
+                self.train_x, self.train_y, sched["idx"], sched["spec"],
+                sched["n_ex"], rng, **kw,
+            )
+        if self._ledger_on:
+            params, opt_state, ledger, metrics = out
+        else:
+            params, opt_state, metrics = out
+        new_state = {
+            "params": params,
+            "server_opt_state": opt_state,
+            "round": round_idx + 1,
+            "rng_key": state["rng_key"],
+            "_metrics": metrics,
+        }
+        if self._ledger_on:
+            new_state["ledger"] = ledger
+        return new_state
+
+    def _drain_device_sched(self) -> None:
+        """Flush-boundary drain of the device-derived schedules: ONE
+        device fetch of every pending round's realized (cohort, spec,
+        weights, churn stats), then the same per-round bookkeeping the
+        host control plane does inline — digest cohorts, wire counters
+        (host_input_bytes=0: no index slab crossed the wire), padded-
+        shape gauges, churn fail counters, phase costs, and the
+        population observatory's cohort/draw feed. Runs FIRST in
+        flush(), so the record loop's pops find everything in place."""
+        if not self._device_sched:
+            return
+        pend = sorted(self._device_sched)
+        with self.tracer.span("round.sched_fetch"):
+            fetched = jax.device_get(
+                [self._device_sched[r] for r in pend]
+            )
+        self._device_sched.clear()
+        for ridx, s in zip(pend, fetched):
+            cohort = np.asarray(s["cohort"], np.int64)
+            spec = np.asarray(s["spec"])
+            n_ex = np.asarray(s["n_ex"])
+            if self._digest_on:
+                self._digest_cohorts[ridx] = cohort.copy()
+            if self._counters_on:
+                stats = self._round_comm(cohort, n_ex)
+                stats["host_input_bytes"] = 0
+                stats.update(round_shape_stats(
+                    spec, self.shape.steps, self.shape.batch_size,
+                    self.shape.local_epochs,
+                ))
+                self._comm_stats[ridx] = stats
+                if self._phase_cost_on:
+                    self._record_phase_cost(
+                        ridx, len(cohort), self.shape.steps,
+                        self.shape.batch_size, 0,
+                    )
+                fail = {
+                    key: int(s[src]) for key, src in (
+                        ("churn_unavailable", "unavailable"),
+                        ("churn_dropped", "dropped"),
+                        ("churn_crashed", "crashed"),
+                    ) if int(s[src])
+                }
+                if fail:
+                    self._fail_stats[ridx] = fail
+            if self._population is not None:
+                self._population.observe_cohort(
+                    ridx, cohort, n_ex,
+                    self._device_draw_stats.pop(ridx, None),
+                )
+
     def _run_hier_round(self, state: Dict[str, Any],
                         round_idx: int) -> Dict[str, Any]:
         """One two-tier synchronous round (``server.hierarchy``): E
@@ -2743,6 +3004,14 @@ class Experiment:
             return self._run_async_round(state, round_idx)
         if self._hier:
             return self._run_hier_round(state, round_idx)
+        if self._cp_device:
+            # device control plane: the program derives its own
+            # schedule — none of the host input machinery below runs
+            return self._run_device_round(
+                state, round_idx,
+                self.cfg.run.fuse_rounds if fuse_override is None
+                else fuse_override,
+            )
         if (self._snapshot_refresh and round_idx > 0
                 and round_idx % self._ledger_cfg.log_every == 0):
             # snapshot/sketch refresh BEFORE this round samples: the
@@ -2885,9 +3154,10 @@ class Experiment:
             with self.tracer.span("round.secagg_keys"):
                 kw["pair_seeds"] = self._pairwise_seeds(round_idx, n_host)
         if self._ledger_on:
-            cohort_ids = jnp.asarray(
-                self._ledger_slot_ids(cohort, round_idx, state)
-            )
+            with self.tracer.span("round.host_inputs.slot_assign"):
+                cohort_ids = jnp.asarray(
+                    self._ledger_slot_ids(cohort, round_idx, state)
+                )
             if self._data_sharding is not None:
                 # sharded: positional trailing (byz, ledger, cohort) so
                 # the ledger input stays donatable
@@ -3044,19 +3314,21 @@ class Experiment:
                     np.stack(byz_rows), self._fused_client_sharding
                 ),)
             if self.ef or self._ledger_on:
-                if self._pager is not None:
-                    # paged ledger: assign hot slots for the CHUNK'S
-                    # cohort union up front (one assignment protects
-                    # every sub-round's residents from mid-chunk
-                    # eviction), seed paged-in slots, then ship slot
-                    # ids; the engine's gather/scatter is unchanged
-                    union = np.unique(np.concatenate(cohorts))
-                    self._ledger_slot_ids(union, round_idx, state)
-                    cohort_rows = np.stack(
-                        [self._pager.lookup(c) for c in cohorts]
-                    )
-                else:
-                    cohort_rows = np.stack(cohorts)
+                with self.tracer.span("round.host_inputs.slot_assign"):
+                    if self._pager is not None:
+                        # paged ledger: assign hot slots for the
+                        # CHUNK'S cohort union up front (one assignment
+                        # protects every sub-round's residents from
+                        # mid-chunk eviction), seed paged-in slots,
+                        # then ship slot ids; the engine's
+                        # gather/scatter is unchanged
+                        union = np.unique(np.concatenate(cohorts))
+                        self._ledger_slot_ids(union, round_idx, state)
+                        cohort_rows = np.stack(
+                            [self._pager.lookup(c) for c in cohorts]
+                        )
+                    else:
+                        cohort_rows = np.stack(cohorts)
                 cohorts_f = self._put(cohort_rows, self._data_sharding)
         common = (state["params"], state["server_opt_state"], train_x,
                   train_y, idx_f, mask_f, n_ex_f, rngs_f)
@@ -3692,6 +3964,7 @@ class Experiment:
                 ),
                 "fused_apply": bool(cfg.server.fused_apply),
                 "double_buffer": bool(self._double_buffer),
+                "control_plane": cfg.run.control_plane,
             })
         if start_round == 0 and self._phase_cost_on:
             # the static half of the cost model (obs/roofline.py): the
@@ -3717,8 +3990,16 @@ class Experiment:
             )
             k_round = int(self._poisson_cap or cfg.server.cohort_size)
             k_local = max(1, k_round // max(1, lanes))
+            # megabatch × LoRA runs the decomposed apply (frozen base
+            # as a closure constant), so the un-batched-weight GEMMs
+            # cover EVERY local step, not just the shared-weight step 0
+            lora_all_steps = bool(
+                cfg.model.lora.enabled
+                and cfg.run.cohort_layout == "megabatch"
+            )
             rows = layout_gemm_rows(
-                cfg.run.cohort_layout, k_local, cfg.client.batch_size
+                cfg.run.cohort_layout, k_local, cfg.client.batch_size,
+                lora_all_steps=lora_all_steps,
             )
             self.logger.log({
                 "event": "phase_cost_model",
@@ -3739,6 +4020,7 @@ class Experiment:
                 "cohort_layout": cfg.run.cohort_layout,
                 "clients_per_lane": int(k_local),
                 "gemm_rows": int(rows),
+                "lora_all_steps": lora_all_steps,
                 "mxu_tile_pad_fraction": round(
                     mxu_tile_pad_fraction(rows), 4
                 ),
@@ -3917,6 +4199,10 @@ class Experiment:
             nonlocal flush_t0
             if not pending:
                 return
+            if self._cp_device:
+                # drain the device-derived schedules FIRST: the record
+                # loop below pops the per-round stats this populates
+                self._drain_device_sched()
             with self.tracer.span("round.fetch"):
                 fetched = jax.device_get([m for _, m in pending])
             dt = time.perf_counter() - flush_t0

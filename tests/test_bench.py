@@ -97,7 +97,7 @@ def test_bench_shapes_validate_and_divide_fuse():
     from colearn_federated_learning_tpu.config import get_named_config
 
     for name, (warmup, timed, overrides) in bench._SHAPES.items():
-        cfg = get_named_config(name)
+        cfg = get_named_config(bench._base_shape_name(name))
         cfg.server.num_rounds = warmup + timed
         cfg.server.eval_every = 0
         cfg.server.checkpoint_every = 0
