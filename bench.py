@@ -808,6 +808,8 @@ def bench_async_throughput(name: str):
                 ),
                 "peak_host_rss_mb": _peak_host_rss_mb(),
                 "coverage_pct": pop_totals.get("population_coverage_pct"),
+                "gather_workers": pop_totals.get("store_gather_workers"),
+                "store_gather_mbps": pop_totals.get("store_gather_mbps"),
                 "budget_floor_updates_per_sec": floor,
                 "meets_budget": (
                     bool(updates_per_sec >= float(floor))
@@ -979,6 +981,8 @@ def bench_hier_async(name: str):
                 ),
                 "peak_host_rss_mb": _peak_host_rss_mb(),
                 "coverage_pct": pop_totals.get("population_coverage_pct"),
+                "gather_workers": pop_totals.get("store_gather_workers"),
+                "store_gather_mbps": pop_totals.get("store_gather_mbps"),
                 "budget_floor_updates_per_sec": floor,
                 "budget_staleness_bound": bound,
                 "meets_budget": meets,
@@ -1102,6 +1106,10 @@ def bench_store_scale(name: str):
                     "population_unique_clients"
                 ),
                 "pager_hit_rate": pop_totals.get("pager_hit_rate"),
+                # store data plane (PR 19): resolved pool width + wall
+                # gather throughput — BENCH_BUDGETS gates the floor
+                "gather_workers": pop_totals.get("store_gather_workers"),
+                "store_gather_mbps": pop_totals.get("store_gather_mbps"),
                 "lora": False,
                 "cohort_layout": cfg.run.cohort_layout,
                 "control_plane": cfg.run.control_plane,
@@ -1210,6 +1218,8 @@ def bench_lora_scale(name: str):
                     "population_unique_clients"
                 ),
                 "pager_hit_rate": pop_totals.get("pager_hit_rate"),
+                "gather_workers": pop_totals.get("store_gather_workers"),
+                "store_gather_mbps": pop_totals.get("store_gather_mbps"),
                 # the adapter-plane headline: full-delta ÷ adapter
                 # upload bytes at this geometry (analytic, config-pure)
                 "lora": True,

@@ -163,6 +163,14 @@ FEATURES: Dict[str, Feature] = {
                             "on-disk mmap client store (dir is a "
                             "validate-level sentinel; existence is "
                             "checked at construction)"),
+    "store_gather_pool": Feature({"data.store.dir": "<store>",
+                                  "data.store.gather_workers": 4}, False,
+                                 "sharded parallel gather pool: rows "
+                                 "split by owning shard, per-shard "
+                                 "copies on a shared worker pool — "
+                                 "bitwise row order at every worker "
+                                 "count (data level; the engine never "
+                                 "sees it)"),
     "native_pipeline": Feature({"run.host_pipeline": "native"}, False,
                                "C++ threaded host pipeline"),
     "lora": Feature({"model.name": "bert_tiny", "model.num_classes": 0,

@@ -105,10 +105,13 @@ REGISTRY: Dict[str, RecordSpec] = {
         required=("rounds", "wall_time_sec", "compiles", "compile_ms"),
         optional=_COMM_FIELDS + (
             "host_prefetched", "placed_prefetched", "prefetch_dropped",
+            "slab_prefetched",
             "ledger_evictions", "ledger_page_syncs",
             "population_unique_clients", "population_coverage_pct",
             "population_participations", "pager_hit_rate",
-            "store_gather_bytes",
+            # store data plane (PR 19): wall throughput + pool width
+            "store_gather_bytes", "store_gather_mbps",
+            "store_gather_workers",
             # production-traffic totals (run.churn / fedbuff promotion)
             "staleness_clamped", "backpressure_dropped",
             "backpressure_rejected", "churn_unavailable", "churn_dropped",

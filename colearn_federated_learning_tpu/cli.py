@@ -151,6 +151,28 @@ def build_parser():
                     help="instead of --config: stream DATA_DIR/femnist "
                          "LEAF json files to shards, one writer per "
                          "client, one file resident at a time")
+    sb.add_argument("--leaf", default=None, metavar="LEAF_DIR",
+                    help="instead of --config: stream ANY LEAF-format "
+                         "json directory (the all_data/*.json layout — "
+                         "femnist, sent140, shakespeare-style flat "
+                         "features) to shards; record shape inferred "
+                         "from the first user")
+    sb.add_argument("--cifar10", default=None, metavar="DATA_DIR",
+                    help="instead of --config: convert the real CIFAR-10 "
+                         "python pickles under DATA_DIR/"
+                         "cifar-10-batches-py into a partitioned record "
+                         "store (two-pass staging, labels-only in RAM) — "
+                         "the cifar10_krum_byzantine store-backed path")
+    sb.add_argument("--clients", type=int, default=100, metavar="N",
+                    help="--cifar10 only: number of clients to "
+                         "partition into (default 100)")
+    sb.add_argument("--partition", default="dirichlet",
+                    help="--cifar10 only: partition kind (dirichlet/"
+                         "iid/shard, as data.partition; default "
+                         "dirichlet)")
+    sb.add_argument("--alpha", type=float, default=0.5,
+                    help="--cifar10 only: dirichlet concentration "
+                         "(default 0.5)")
     sb.add_argument("--examples-per-client", type=int, default=2)
     sb.add_argument("--shape", default="12,12,1",
                     help="synthetic example shape, comma-separated "
@@ -366,10 +388,12 @@ def main(argv=None):
                 print(store_mod.format_store_info(info))
             return 0
         # build: exactly one source
-        sources = [args.config, args.synthetic_clients, args.leaf_femnist]
+        sources = [args.config, args.synthetic_clients, args.leaf_femnist,
+                   args.leaf, args.cifar10]
         if sum(s is not None for s in sources) != 1:
             print("error: store build needs exactly one of --config, "
-                  "--synthetic-clients, or --leaf-femnist",
+                  "--synthetic-clients, --leaf-femnist, --leaf, or "
+                  "--cifar10",
                   file=sys.stderr)
             return 2
         try:
@@ -377,6 +401,17 @@ def main(argv=None):
                 out = store_mod.write_femnist_store(
                     args.leaf_femnist, args.out, seed=args.seed,
                     shard_mb=args.shard_mb,
+                )
+            elif args.leaf is not None:
+                out = store_mod.write_leaf_store(
+                    args.leaf, args.out, seed=args.seed,
+                    shard_mb=args.shard_mb,
+                )
+            elif args.cifar10 is not None:
+                out = store_mod.write_cifar10_store(
+                    args.cifar10, args.out, num_clients=args.clients,
+                    partition=args.partition, alpha=args.alpha,
+                    seed=args.seed, shard_mb=args.shard_mb,
                 )
             elif args.config is not None:
                 cfg = resolve_config(

@@ -96,6 +96,19 @@ class StoreConfig:
     # in-memory path — the "in-memory twin" for store↔in-memory parity
     # checks; only sensible for stores that fit in RAM
     materialize: bool = False
+    # parallel shard-gather pool width (data/store.py): a slab's row
+    # set is split by owning shard and the per-shard mmap copies run
+    # concurrently on a shared worker pool. 0 = auto (min(4, cores)),
+    # 1 = serial, N = exactly N threads. Deterministic at EVERY
+    # setting — workers write disjoint output rows, so the gathered
+    # bytes never depend on the worker count (test-pinned).
+    gather_workers: int = 0
+    # bounded reassembly buffer (MB) for store-backed federated /
+    # personalized eval: eval batches stream through the contiguous
+    # client-index ranges in bounded multi-client slabs instead of
+    # materializing a transient per-client arange gather each —
+    # bitwise-identical metrics, O(buffer) host residency.
+    eval_buffer_mb: int = 256
 
 
 @dataclass
@@ -2504,6 +2517,16 @@ class ExperimentConfig:
                     f"need availability renormalization)"
                 )
         st = self.data.store
+        if st.gather_workers < 0:
+            raise ValueError(
+                f"data.store.gather_workers must be >= 0 (0 = auto), "
+                f"got {st.gather_workers}"
+            )
+        if st.eval_buffer_mb < 1:
+            raise ValueError(
+                f"data.store.eval_buffer_mb must be >= 1, "
+                f"got {st.eval_buffer_mb}"
+            )
         if st.dir:
             if self.attack.kind == "label_flip":
                 raise ValueError(

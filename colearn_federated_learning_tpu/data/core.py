@@ -355,7 +355,9 @@ def build_federated_data(cfg: DataConfig, seed: int = 0, **model_kwargs) -> Fede
     if cfg.store.dir:
         from colearn_federated_learning_tpu.data.store import open_store
 
-        return open_store(cfg.store.dir).as_federated_data(
+        return open_store(
+            cfg.store.dir, gather_workers=cfg.store.gather_workers
+        ).as_federated_data(
             expected_clients=cfg.num_clients,
             materialize=cfg.store.materialize,
         )

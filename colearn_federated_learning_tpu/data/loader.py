@@ -311,6 +311,61 @@ def make_round_indices(
     return idx, mask_from_spec(spec, shape), n_examples
 
 
+def iter_client_slabs(train_x, train_y, client_indices, client_ids,
+                      buffer_bytes: int):
+    """Stream per-client example arrays for eval, store-aware.
+
+    Yields ``(cid, x, y)`` for every client in ``client_ids`` (order
+    preserved). Store-backed federations expose ``client_indices`` as a
+    lazy :class:`~colearn_federated_learning_tpu.data.store
+    .ClientIndexView` whose ``starts`` make every client a contiguous
+    global-id range — so instead of materializing a transient per-client
+    arange and issuing one random-access gather per client, consecutive
+    requested clients are COALESCED into one contiguous multi-client
+    range gather (shard-by-shard sequential reads through the mmap),
+    bounded by ``buffer_bytes`` of reassembly buffer, and the
+    per-client views are sliced out of that slab. The bytes handed to
+    the caller are identical either way — store-backed eval stays
+    bitwise-equal to its in-memory twin (test-pinned).
+
+    In-memory federations (plain index lists) take the classic
+    per-client fancy-index path unchanged."""
+    starts = getattr(client_indices, "starts", None)
+    if starts is None or not hasattr(train_x, "gather"):
+        for cid in client_ids:
+            ids = np.asarray(client_indices[cid])
+            yield cid, train_x[ids], train_y[ids]
+        return
+    rec_bytes = (
+        int(np.prod(train_x.shape[1:]) or 1) * train_x.dtype.itemsize
+        + int(np.prod(train_y.shape[1:]) or 1) * train_y.dtype.itemsize
+    )
+    max_rows = max(1, int(buffer_bytes) // max(rec_bytes, 1))
+    group: list = []
+    rows = 0
+
+    def flush(group):
+        lo = int(starts[group[0]])
+        hi = int(starts[group[-1] + 1])
+        slab_x = train_x[lo:hi]
+        slab_y = train_y[lo:hi]
+        for cid in group:
+            a, b = int(starts[cid]) - lo, int(starts[cid + 1]) - lo
+            yield cid, slab_x[a:b], slab_y[a:b]
+
+    for cid in client_ids:
+        cid = int(cid)
+        n = int(starts[cid + 1] - starts[cid])
+        contiguous = bool(group) and cid == group[-1] + 1
+        if group and (not contiguous or rows + n > max_rows):
+            yield from flush(group)
+            group, rows = [], 0
+        group.append(cid)
+        rows += n
+    if group:
+        yield from flush(group)
+
+
 def eval_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     """Pad the test set to a whole number of fixed-size batches.
 

@@ -69,6 +69,46 @@ def _shard_name(i: int, kind: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# the shared gather pool (data.store.gather_workers)
+# ---------------------------------------------------------------------------
+
+# One process-wide pool shared by every ShardedRecordArray (x and y of
+# every open store): shard gathers are mmap page faults + memcpy, both
+# of which release the GIL, so a handful of threads saturate the
+# storage stack without oversubscribing the host. The pool is created
+# lazily on the first parallel gather and grown (never shrunk) to the
+# largest worker count any array asked for.
+_POOL_GUARD = threading.Lock()
+_POOL = None
+_POOL_SIZE = 0
+
+
+def resolve_gather_workers(n: int) -> int:
+    """``data.store.gather_workers`` resolution: 0 = auto (a small
+    multiple of available cores, capped — gathers are I/O-bound, not
+    compute-bound), 1 = serial, N = exactly N."""
+    if n and int(n) > 0:
+        return int(n)
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def _gather_pool(workers: int):
+    global _POOL, _POOL_SIZE
+    with _POOL_GUARD:
+        if _POOL is None or _POOL_SIZE < workers:
+            from concurrent.futures import ThreadPoolExecutor
+
+            old = _POOL
+            _POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="colearn-gather"
+            )
+            _POOL_SIZE = workers
+            if old is not None:
+                old.shutdown(wait=False)
+        return _POOL
+
+
+# ---------------------------------------------------------------------------
 # the mmap-backed record array
 # ---------------------------------------------------------------------------
 
@@ -87,7 +127,8 @@ class ShardedRecordArray:
     """
 
     def __init__(self, paths: Sequence[str], shard_counts: Sequence[int],
-                 rec_shape: Sequence[int], dtype) -> None:
+                 rec_shape: Sequence[int], dtype,
+                 gather_workers: int = 0) -> None:
         self._paths = list(paths)
         self._bounds = np.concatenate(
             [[0], np.cumsum(np.asarray(shard_counts, np.int64))]
@@ -96,16 +137,34 @@ class ShardedRecordArray:
         self.dtype = np.dtype(dtype)
         self.shape = (int(self._bounds[-1]),) + self._rec_shape
         self._maps: List[Optional[np.memmap]] = [None] * len(self._paths)
+        # per-shard DATA locks guard only lazy memmap creation: once a
+        # shard's map exists reads are lock-free (read-only mmaps), so
+        # pool workers touching different shards never serialize and
+        # workers racing to the SAME unmapped shard create it exactly
+        # once
+        self._map_locks = [threading.Lock() for _ in self._paths]
+        self._workers = resolve_gather_workers(gather_workers)
+        # multi-host shard ownership (None = every shard owned): a bool
+        # mask of the shards whose clients land on this process's
+        # lanes; non-owned touches either fault a read replica (counted)
+        # or raise, per _replica_fallback
+        self._owned: Optional[np.ndarray] = None
+        self._replica_fallback = True
         # gather-I/O accounting (obs/population.py store-health plane):
-        # calls / rows / bytes copied out of the mmaps, wall ms, and a
-        # fixed-size per-shard touch histogram. Gathers run on the fit
-        # thread AND the prefetch worker, so updates take the lock; the
-        # counts are a pure function of which slabs were built (engine-
-        # independent), ms is wall clock.
+        # calls / rows / bytes copied out of the mmaps, wall ms, summed
+        # per-worker I/O ms, and a fixed-size per-shard touch histogram.
+        # Gathers run on the fit thread, the prefetch worker, AND the
+        # gather pool; each call folds its increments in with ONE short
+        # acquisition of this dedicated stats lock — the data path
+        # (mmap creation, record copies) never holds it, so a
+        # gather_stats() reader can never stall a hot gather
         self._stats_lock = threading.Lock()
         self._gather_calls = 0
         self._gather_rows = 0
         self._gather_ms = 0.0
+        self._gather_io_ms = 0.0
+        self._pool_gathers = 0
+        self._replica_rows = 0
         self._shard_touches = np.zeros(len(self._paths), np.int64)
 
     # ---- ndarray-protocol surface -----------------------------------
@@ -128,26 +187,75 @@ class ShardedRecordArray:
     def _map(self, s: int) -> np.memmap:
         m = self._maps[s]
         if m is None:
-            n = int(self._bounds[s + 1] - self._bounds[s])
-            m = np.memmap(self._paths[s], dtype=self.dtype, mode="r",
-                          shape=(n,) + self._rec_shape)
-            try:
-                # cohort gathers are random-access by construction;
-                # without this the kernel's sequential readahead drags
-                # ~128 KB of neighbouring records into RSS per touched
-                # record, which at 10⁶ clients dominates the host-
-                # memory budget the store exists to hold flat
-                import mmap as _mmap
+            if self._owned is not None and not self._owned[s]:
+                if not self._replica_fallback:
+                    raise RuntimeError(
+                        f"shard {s} ({self._paths[s]!r}) is not owned by "
+                        f"this process and read-replica fallback is "
+                        f"disabled — the cohort sharding routed a "
+                        f"non-local client's rows here"
+                    )
+            with self._map_locks[s]:
+                m = self._maps[s]
+                if m is not None:
+                    return m  # a pool peer won the race
+                n = int(self._bounds[s + 1] - self._bounds[s])
+                m = np.memmap(self._paths[s], dtype=self.dtype, mode="r",
+                              shape=(n,) + self._rec_shape)
+                try:
+                    # cohort gathers are random-access by construction;
+                    # without this the kernel's sequential readahead
+                    # drags ~128 KB of neighbouring records into RSS per
+                    # touched record, which at 10⁶ clients dominates the
+                    # host-memory budget the store exists to hold flat
+                    import mmap as _mmap
 
-                m._mmap.madvise(_mmap.MADV_RANDOM)
-            except (AttributeError, OSError, ValueError):
-                pass  # platform without madvise: correctness unchanged
-            self._maps[s] = m
+                    m._mmap.madvise(_mmap.MADV_RANDOM)
+                except (AttributeError, OSError, ValueError):
+                    pass  # platform without madvise: correctness unchanged
+                self._maps[s] = m
         return m
+
+    def set_gather_workers(self, n: int) -> None:
+        self._workers = resolve_gather_workers(n)
+
+    def set_shard_ownership(self, owned, replica_fallback: bool = True) -> None:
+        """Multi-host shard ownership: mark the shards this process's
+        lanes own. Owned shards mmap locally as usual; a gather row
+        landing on a non-owned shard either faults it as a READ REPLICA
+        (default — correctness everywhere, the touch is counted in
+        ``gather_stats()['replica_rows']`` so weak-scaling runs can see
+        cross-host leakage) or raises (``replica_fallback=False``, the
+        strict mode for perfectly lane-aligned cohorts). Pass
+        ``owned=None`` to clear."""
+        if owned is None:
+            self._owned = None
+            return
+        mask = np.zeros(len(self._paths), bool)
+        mask[np.asarray(list(owned), np.int64)] = True
+        self._owned = mask
+        self._replica_fallback = bool(replica_fallback)
+
+    def owned_shard_range(self, ex_lo: int, ex_hi: int) -> range:
+        """The shards holding global example ids ``[ex_lo, ex_hi)`` —
+        client-contiguous ids make ownership a pure function of the
+        shard start offsets (no index scan)."""
+        if ex_hi <= ex_lo:
+            return range(0, 0)
+        s_lo = int(np.searchsorted(self._bounds, ex_lo, side="right") - 1)
+        s_hi = int(np.searchsorted(self._bounds, ex_hi - 1, side="right") - 1)
+        return range(s_lo, s_hi + 1)
 
     def gather(self, ids) -> np.ndarray:
         """Copy the records at global ``ids`` (any order, duplicates ok)
-        into a fresh array — the O(rows) slab-gather primitive."""
+        into a fresh array — the O(rows) slab-gather primitive.
+
+        With ``gather_workers > 1`` the row set is split by owning
+        shard and the per-shard copies run concurrently on the shared
+        pool. Each worker writes a DISJOINT destination row set, so the
+        output is bitwise-identical for every worker count and
+        completion order — parallelism changes wall time, never bytes
+        (pinned by tests/test_store_data_plane.py)."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         if ids.size and (ids.min() < 0 or ids.max() >= len(self)):
             raise IndexError(
@@ -157,22 +265,57 @@ class ShardedRecordArray:
         out = np.empty((len(ids),) + self._rec_shape, self.dtype)
         shard = np.searchsorted(self._bounds, ids, side="right") - 1
         touched = np.unique(shard)
-        for s in touched:
-            sel = shard == s
-            out[sel] = self._map(int(s))[ids[sel] - self._bounds[s]]
+        # presort rows by owning shard ONCE: each shard's destination
+        # rows become one slice of `order` (original order preserved
+        # within a shard — stable sort), so per-shard work is O(its
+        # rows) instead of every worker rescanning the full id vector
+        order = np.argsort(shard, kind="stable")
+        run_starts = np.searchsorted(shard[order], touched, side="left")
+        run_stops = np.append(run_starts[1:], len(ids))
+        owned = self._owned
+
+        def copy_shard(k: int):
+            s = int(touched[k])
+            rows = order[run_starts[k]:run_stops[k]]
+            t1 = time.perf_counter()
+            out[rows] = self._map(s)[ids[rows] - self._bounds[s]]
+            replica = 0 if owned is None or owned[s] else len(rows)
+            return time.perf_counter() - t1, replica
+
+        workers = min(self._workers, len(touched))
+        if workers > 1:
+            pool = _gather_pool(self._workers)
+            parts = [
+                f.result()
+                for f in [pool.submit(copy_shard, k)
+                          for k in range(len(touched))]
+            ]
+        else:
+            parts = [copy_shard(k) for k in range(len(touched))]
+        io_ms = sum(p[0] for p in parts) * 1000.0
+        replica_rows = sum(p[1] for p in parts)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
         with self._stats_lock:
             self._gather_calls += 1
             self._gather_rows += len(ids)
-            self._gather_ms += (time.perf_counter() - t0) * 1000.0
+            self._gather_ms += wall_ms
+            self._gather_io_ms += io_ms
+            self._replica_rows += replica_rows
+            if workers > 1:
+                self._pool_gathers += 1
             if touched.size:
                 self._shard_touches[touched] += 1
         return out
 
     def gather_stats(self) -> Dict[str, Any]:
         """Cumulative gather-I/O counters (population-health store
-        plane): calls, rows/bytes copied, wall ms, per-shard touch
-        counts. The caller (PopulationTracker) deltas consecutive
-        snapshots into per-window numbers."""
+        plane): calls, rows/bytes copied, wall ms, summed per-worker
+        I/O ms (``io_ms / ms`` reads as the pool's realized overlap
+        factor), pool/replica activity, and per-shard touch counts.
+        The caller (PopulationTracker) deltas consecutive snapshots
+        into per-window numbers. Snapshotting acquires only the tiny
+        stats lock — never a data lock — so a reader polling this
+        mid-run cannot stall a hot gather."""
         rec_bytes = int(np.prod(self._rec_shape)) * self.itemsize
         with self._stats_lock:
             return {
@@ -180,6 +323,10 @@ class ShardedRecordArray:
                 "rows": int(self._gather_rows),
                 "bytes": int(self._gather_rows) * rec_bytes,
                 "ms": float(self._gather_ms),
+                "io_ms": float(self._gather_io_ms),
+                "workers": int(self._workers),
+                "pool_gathers": int(self._pool_gathers),
+                "replica_rows": int(self._replica_rows),
                 "shard_touches": self._shard_touches.copy(),
             }
 
@@ -512,6 +659,147 @@ def write_femnist_store(data_dir: str, out_dir: str,
     return out_dir
 
 
+def write_leaf_store(leaf_dir: str, out_dir: str,
+                     test_fraction: float = 0.1, seed: int = 0,
+                     shard_mb: float = 64) -> str:
+    """Generic LEAF→store direct converter (``colearn store build
+    --leaf <dir>``): stream ANY LEAF classification json dir straight
+    through the shard writer, one json file resident at a time — the
+    corpus is never materialized. Record geometry is inferred from the
+    first user's examples (flat 784-vectors are restored to the
+    conventional ``[28, 28, 1]`` image records, anything else keeps
+    its per-example shape); the label space is the max label seen,
+    finalized in meta after the stream ends. The per-client held-out
+    split consumes the rng exactly like :func:`write_femnist_store`
+    (one permutation per user, in stream order), so the same dir
+    converted twice is byte-identical."""
+    from colearn_federated_learning_tpu.data.leaf import iter_leaf_clients
+
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    writer = _ShardWriter(out_dir, shard_mb)
+    counts: List[int] = []
+    test_xs: List[np.ndarray] = []
+    test_ys: List[np.ndarray] = []
+    rec_shape: Optional[tuple] = None
+    max_label = -1
+    for _u, ud in iter_leaf_clients(leaf_dir):
+        x = np.asarray(ud["x"], np.float32)
+        if rec_shape is None:
+            # LEAF image corpora ship flat pixel rows; restore the
+            # square single-channel geometry when it exists (FEMNIST's
+            # 784 → 28x28x1), else keep the flat record as-is
+            side = int(round(x.shape[-1] ** 0.5)) if x.ndim == 2 else 0
+            if x.ndim == 2 and side * side == x.shape[-1]:
+                rec_shape = (side, side, 1)
+            else:
+                rec_shape = tuple(x.shape[1:])
+        x = x.reshape((-1,) + rec_shape)
+        y = np.asarray(ud["y"], np.int32)
+        if y.size:
+            max_label = max(max_label, int(y.max()))
+        n_test = max(1, int(len(x) * test_fraction)) if len(x) > 1 else 0
+        perm = rng.permutation(len(x))
+        test_ix, train_ix = perm[:n_test], perm[n_test:]
+        writer.write_clients(x[train_ix], y[train_ix])
+        counts.append(len(train_ix))
+        test_xs.append(x[test_ix])
+        test_ys.append(y[test_ix])
+    if not counts:
+        raise ValueError(f"no LEAF users found under {leaf_dir!r}")
+    writer.close_shard()
+    np.savez(os.path.join(out_dir, _TEST),
+             x=np.concatenate(test_xs), y=np.concatenate(test_ys))
+    _write_meta(
+        out_dir, counts=np.asarray(counts, np.int64),
+        shard_counts=writer.shard_counts,
+        x_shape=rec_shape, x_dtype=np.float32,
+        y_shape=(), y_dtype=np.int32,
+        num_classes=max_label + 1, task="classify",
+        source=f"store(leaf:{os.path.basename(os.path.abspath(leaf_dir))})",
+        test_examples=int(sum(len(t) for t in test_xs)),
+    )
+    return out_dir
+
+
+def write_cifar10_store(data_dir: str, out_dir: str, num_clients: int,
+                        partition: str = "dirichlet", alpha: float = 0.5,
+                        seed: int = 0, shard_mb: float = 64) -> str:
+    """CIFAR-10 record-store conversion (``colearn store build
+    --cifar10 <data_dir>``): turn the ``cifar-10-batches-py`` pickles
+    into a client store with the SAME partition draw the in-memory
+    loader realizes — `cifar10_krum_byzantine` (and any cifar10
+    config) then runs store-backed bitwise-equal to its in-memory
+    twin on the same seed.
+
+    Bounded-memory shape: pass 1 streams the five train pickles into
+    an on-disk raw record staging file (one pickle batch resident at a
+    time) keeping only the 50k int32 labels in RAM; the partition is
+    drawn from those labels; pass 2 writes clients in id order by
+    mmap-gathering each client's rows from the staging file (page
+    cache, not RSS). Peak host memory is O(one pickle batch + largest
+    client), never O(corpus)."""
+    import pickle
+
+    from colearn_federated_learning_tpu.data import partition as partition_lib
+
+    base = os.path.join(os.path.expanduser(data_dir), "cifar-10-batches-py")
+    if not os.path.isdir(base):
+        raise FileNotFoundError(
+            f"no CIFAR-10 pickles under {base!r} — the record-store "
+            f"converter needs the real ``cifar-10-batches-py`` files "
+            f"(for the synthetic fallback use `colearn store build "
+            f"--config <cifar10 config>`)"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+
+    def read(fname):
+        with open(os.path.join(base, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return np.ascontiguousarray(x), np.array(d[b"labels"], np.int32)
+
+    stage_path = os.path.join(out_dir, ".cifar_stage.bin")
+    labels: List[np.ndarray] = []
+    n_total = 0
+    with open(stage_path, "wb") as stage:
+        for i in range(1, 6):
+            x, y = read(f"data_batch_{i}")
+            stage.write(x.tobytes())
+            labels.append(y)
+            n_total += len(x)
+    ty = np.concatenate(labels)
+    # identical draw to build_federated_data: same partitioner, same
+    # labels, same seed ⇒ identical client_indices
+    client_indices = partition_lib.partition(
+        partition, labels=ty, num_clients=num_clients, num_classes=10,
+        alpha=alpha, seed=seed,
+    )
+    stage_x = np.memmap(stage_path, dtype=np.uint8, mode="r",
+                        shape=(n_total, 32, 32, 3))
+    writer = _ShardWriter(out_dir, shard_mb)
+    counts = np.array([len(ix) for ix in client_indices], np.int64)
+    try:
+        for ids in client_indices:
+            ids = np.asarray(ids)
+            writer.write_clients(np.asarray(stage_x[ids]), ty[ids])
+        writer.close_shard()
+    finally:
+        del stage_x
+        os.remove(stage_path)
+    ex, ey = read("test_batch")
+    np.savez(os.path.join(out_dir, _TEST), x=ex, y=ey)
+    _write_meta(
+        out_dir, counts=counts, shard_counts=writer.shard_counts,
+        x_shape=(32, 32, 3), x_dtype=np.uint8,
+        y_shape=(), y_dtype=np.int32,
+        num_classes=10, task="classify", source="store(cifar10)",
+        test_examples=len(ex),
+        extra={"partition": partition, "seed": int(seed)},
+    )
+    return out_dir
+
+
 # ---------------------------------------------------------------------------
 # reading
 # ---------------------------------------------------------------------------
@@ -522,8 +810,9 @@ class ClientStore:
     two ints per client), mmap record arrays for x/y, and the bounded
     eval split (loaded to RAM — it is shared, not per-client)."""
 
-    def __init__(self, store_dir: str) -> None:
+    def __init__(self, store_dir: str, gather_workers: int = 0) -> None:
         self.dir = os.path.abspath(os.path.expanduser(store_dir))
+        self.gather_workers = resolve_gather_workers(gather_workers)
         meta_path = os.path.join(self.dir, _META)
         try:
             with open(meta_path) as f:
@@ -553,6 +842,7 @@ class ClientStore:
                  for i in range(len(shard_counts))],
                 shard_counts,
                 self.meta[shape_key], self.meta[dtype_key],
+                gather_workers=self.gather_workers,
             )
 
         self.x = arr("x", "x_shape", "x_dtype")
@@ -564,6 +854,46 @@ class ClientStore:
     @property
     def num_clients(self) -> int:
         return int(len(self.counts))
+
+    def process_client_block(self, process_index: int,
+                             process_count: int) -> range:
+        """The contiguous client-id block process ``p`` of ``P`` owns —
+        the balanced split ``[floor(p·C/P), floor((p+1)·C/P))``. Pure
+        arithmetic: every process computes every block identically."""
+        c = self.num_clients
+        return range((process_index * c) // process_count,
+                     ((process_index + 1) * c) // process_count)
+
+    def apply_process_ownership(self, process_index: int,
+                                process_count: int,
+                                replica_fallback: bool = True,
+                                ) -> Dict[str, Any]:
+        """Multi-host shard ownership (the weak-scaling page-cache
+        rule): mark on x/y the shards whose clients land on this
+        process's contiguous client block. Client-contiguous global
+        ids make the owned shard set a pure function of the shard
+        start offsets — no per-client scan. Boundary shards holding
+        two processes' clients are owned by BOTH (read-replica
+        semantics keep that correct). Returns the realized mapping for
+        logging."""
+        if not 0 <= process_index < process_count:
+            raise ValueError(
+                f"process_index {process_index} out of range "
+                f"[0, {process_count})"
+            )
+        block = self.process_client_block(process_index, process_count)
+        starts = np.concatenate([[0], np.cumsum(self.counts)])
+        ex_lo, ex_hi = int(starts[block.start]), int(starts[block.stop])
+        owned = self.x.owned_shard_range(ex_lo, ex_hi)
+        for a in (self.x, self.y):
+            a.set_shard_ownership(owned, replica_fallback=replica_fallback)
+        return {
+            "process_index": int(process_index),
+            "process_count": int(process_count),
+            "clients": [block.start, block.stop],
+            "owned_shards": [owned.start, owned.stop],
+            "num_shards": len(self.meta["shard_examples"]),
+        }
 
     def as_federated_data(self, expected_clients: Optional[int] = None,
                           materialize: bool = False):
@@ -650,8 +980,8 @@ class ClientStore:
         }
 
 
-def open_store(store_dir: str) -> ClientStore:
-    return ClientStore(store_dir)
+def open_store(store_dir: str, gather_workers: int = 0) -> ClientStore:
+    return ClientStore(store_dir, gather_workers=gather_workers)
 
 
 def format_store_info(info: Dict[str, Any]) -> str:

@@ -454,6 +454,13 @@ class PopulationTracker:
                 "rows": sum(s["rows"] for s in store_stats),
                 "bytes": sum(s["bytes"] for s in store_stats),
                 "ms": sum(s["ms"] for s in store_stats),
+                "io_ms": sum(s.get("io_ms", 0.0) for s in store_stats),
+                "pool_gathers": sum(
+                    s.get("pool_gathers", 0) for s in store_stats
+                ),
+                "replica_rows": sum(
+                    s.get("replica_rows", 0) for s in store_stats
+                ),
             }
             touches = [np.asarray(s["shard_touches"]) for s in store_stats]
             width = max(len(t) for t in touches)
@@ -463,6 +470,7 @@ class PopulationTracker:
             if self._store_base is None:
                 self._store_base = {
                     "calls": 0, "rows": 0, "bytes": 0, "ms": 0.0,
+                    "io_ms": 0.0, "pool_gathers": 0, "replica_rows": 0,
                     "touches": np.zeros(width, np.int64),
                 }
             base = self._store_base
@@ -471,10 +479,30 @@ class PopulationTracker:
                 "rows_gathered": int(cur_s["rows"] - base["rows"]),
                 "bytes_gathered": int(cur_s["bytes"] - base["bytes"]),
                 "gather_ms": round(cur_s["ms"] - base["ms"], 3),
+                # summed per-shard copy time vs the wall gather_ms: the
+                # pool's overlap factor reads directly off the pair
+                # (io_ms ≈ gather_ms → serial; io_ms >> gather_ms →
+                # the worker pool is hiding shard I/O)
+                "gather_io_ms": round(
+                    cur_s["io_ms"] - base.get("io_ms", 0.0), 3
+                ),
+                "gather_workers": max(
+                    int(s.get("workers", 1)) for s in store_stats
+                ),
+                "pool_gathers": int(
+                    cur_s["pool_gathers"] - base.get("pool_gathers", 0)
+                ),
                 "shard_touches": [
                     int(v) for v in (tot_touch - base["touches"])
                 ],
             }
+            replica = int(
+                cur_s["replica_rows"] - base.get("replica_rows", 0)
+            )
+            if replica:
+                # multi-host ownership: rows served from NON-owned
+                # shards via read-replica fallback this window
+                rec["store"]["replica_rows"] = replica
             self._store_base = dict(cur_s, touches=tot_touch)
         if self._w_slab_indexed:
             rec.setdefault("store", {}).update({
@@ -562,12 +590,24 @@ class PopulationTracker:
             looked = int(pager.hits) + int(pager.misses)
             if looked:
                 out["pager_hit_rate"] = round(int(pager.hits) / looked, 4)
-        total_bytes = sum(
-            a.gather_stats()["bytes"] for a in store_arrays
+        stats = [
+            a.gather_stats() for a in store_arrays
             if hasattr(a, "gather_stats")
-        )
+        ]
+        total_bytes = sum(s["bytes"] for s in stats)
         if total_bytes:
             out["store_gather_bytes"] = int(total_bytes)
+            total_ms = sum(s["ms"] for s in stats)
+            if total_ms:
+                # wall-clock store throughput — the budget-gated
+                # data-plane headline (BENCH_BUDGETS
+                # store_gather_mbps_min via `colearn bench-report`)
+                out["store_gather_mbps"] = round(
+                    total_bytes / (1 << 20) / (total_ms / 1e3), 1
+                )
+            out["store_gather_workers"] = max(
+                int(s.get("workers", 1)) for s in stats
+            )
         return out
 
 
@@ -939,9 +979,12 @@ def population_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     pager = {"hits": 0, "misses": 0, "page_ins": 0, "evictions": 0,
              "page_syncs": 0, "sync_stall_ms": 0.0}
     store = {"gather_calls": 0, "rows_gathered": 0, "bytes_gathered": 0,
-             "gather_ms": 0.0, "slab_rows_indexed": 0, "slab_rows_unique": 0}
+             "gather_ms": 0.0, "gather_io_ms": 0.0, "pool_gathers": 0,
+             "replica_rows": 0, "slab_rows_indexed": 0,
+             "slab_rows_unique": 0}
     shard_touches: List[int] = []
     rounds = participants = 0
+    gather_workers = 0
     cov_series: List[float] = []
     saw_pager = saw_store = False
     asy = {"server_steps": 0, "updates_absorbed": 0, "staleness_max": 0,
@@ -985,6 +1028,9 @@ def population_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             saw_store = True
             for k in store:
                 store[k] += s.get(k, 0)
+            gather_workers = max(
+                gather_workers, int(s.get("gather_workers", 0))
+            )
             for i, t in enumerate(s.get("shard_touches") or []):
                 while len(shard_touches) <= i:
                     shard_touches.append(0)
@@ -1023,6 +1069,15 @@ def population_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         )
     if saw_store:
         report["store"] = dict(store)
+        if gather_workers:
+            report["store"]["gather_workers"] = gather_workers
+        if store["gather_ms"]:
+            # wall-clock gather throughput — the data-plane headline
+            # (`store_gather_mbps`, budget-gated by `colearn bench-report`)
+            report["store"]["store_gather_mbps"] = round(
+                store["bytes_gathered"] / (1 << 20)
+                / (store["gather_ms"] / 1e3), 1
+            )
         if shard_touches:
             report["store"]["shard_touches"] = shard_touches
         if store["slab_rows_indexed"]:
@@ -1125,6 +1180,15 @@ def format_population_report(report: Dict[str, Any], path: str = "") -> str:
             f"in {st.get('gather_calls', 0)} gathers "
             f"({st.get('gather_ms', 0.0):.1f} ms)"
         )
+        if "store_gather_mbps" in st:
+            line += f"  {st['store_gather_mbps']:.0f} MiB/s"
+        if st.get("gather_workers", 0) > 1:
+            line += (
+                f"  pool x{st['gather_workers']} "
+                f"(io {st.get('gather_io_ms', 0.0):.1f} ms summed)"
+            )
+        if st.get("replica_rows"):
+            line += f"  replica rows {st['replica_rows']}"
         if "slab_dedup_ratio" in st:
             line += (
                 f"  slab dedup {st['slab_dedup_ratio']:.2f} "

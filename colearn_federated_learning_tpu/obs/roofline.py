@@ -702,8 +702,45 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
             "host_exposed_pct": extra.get("host_exposed_pct"),
             "weak_scale": _tail_weak_scale_records(doc, parsed),
             "async_throughput": _tail_async_records(doc, parsed),
+            "store_gather": _tail_store_records(doc, parsed),
         })
     return entries
+
+
+def _tail_store_records(doc, parsed) -> List[Dict[str, Any]]:
+    """Store-backed bench records carrying the ``store_gather_mbps``
+    extra in one BENCH_r*.json — the file's own parsed entry or extra
+    ``--matrix`` tail lines, like the async/weak-scale scans. These
+    feed the ``store_gather_mbps_min`` gate; entries predating the
+    data-plane extras (r01–r18) are simply absent, never an error."""
+    candidates: List[Dict[str, Any]] = []
+    for line in str(doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and "store_gather_mbps" in line):
+            continue
+        try:
+            candidates.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if (parsed.get("extra") or {}).get("store_gather_mbps") is not None:
+        candidates.append(parsed)
+    records: List[Dict[str, Any]] = []
+    seen = set()
+    for rec in candidates:
+        extra = rec.get("extra") or {}
+        mbps = extra.get("store_gather_mbps")
+        if mbps is None:
+            continue
+        name = str(rec.get("config") or rec.get("metric") or "store")
+        if name in seen:
+            continue
+        seen.add(name)
+        records.append({
+            "name": name,
+            "store_gather_mbps": float(mbps),
+            "gather_workers": extra.get("gather_workers"),
+        })
+    return records
 
 
 def _tail_async_records(doc, parsed) -> List[Dict[str, Any]]:
@@ -908,6 +945,21 @@ def bench_report(entries: Sequence[Dict[str, Any]],
     # gate on BOTH axes — the shared throughput floor above AND the
     # realized-staleness bound here, so trading staleness for
     # throughput cannot pass the report
+    # store-gather throughput floor (the store data plane): gate the
+    # NEWEST entry carrying store_gather records — histories that
+    # predate the extras never fire (n/a is a provenance gap, not a
+    # regression)
+    mbps_min = budgets.get("store_gather_mbps_min")
+    if mbps_min is not None:
+        with_store = [e for e in entries if e.get("store_gather")]
+        if with_store:
+            for rec in with_store[-1]["store_gather"]:
+                if rec["store_gather_mbps"] < float(mbps_min):
+                    violations.append(
+                        f"store gather {rec['store_gather_mbps']:.1f} "
+                        f"MiB/s < budget floor {float(mbps_min):.1f} "
+                        f"({rec['name']}, {with_store[-1]['file']})"
+                    )
     stale_max = budgets.get("hier_async_staleness_bound")
     if stale_max is not None:
         with_async = [e for e in entries if e.get("async_throughput")]

@@ -50,6 +50,44 @@ class RoundMetrics(NamedTuple):
     examples: jnp.ndarray  # total real examples processed
 
 
+def apply_store_shard_ownership(fed, replica_fallback: bool = True):
+    """Multi-host shard ownership for store-backed federations (the
+    weak-scaling page-cache rule): mark on the mmap record arrays the
+    store shards whose clients land on this process's lanes, so each
+    host's gathers fault only its own shards' pages in steady state.
+
+    The lane→client rule mirrors the engines' host-input contract:
+    cohort rows shard over the mesh's client axis in contiguous lane
+    blocks, and processes own contiguous client-id blocks
+    ``[floor(p·C/P), floor((p+1)·C/P))`` — with the store's
+    client-contiguous global ids, the owned shard set is then a pure
+    function of the shard start offsets (``owned_shard_range``), no
+    index scan. Off-block touches (a sampled cohort is never perfectly
+    lane-aligned) fall back to READ REPLICAS — correct everywhere,
+    counted in ``gather_stats()['replica_rows']``.
+
+    No-op (returns None) on single-process runs and non-store
+    federations."""
+    if jax.process_count() <= 1:
+        return None
+    starts = getattr(fed.client_indices, "starts", None)
+    if starts is None or not hasattr(fed.train_x, "set_shard_ownership"):
+        return None
+    p, n = jax.process_index(), jax.process_count()
+    c = fed.num_clients
+    lo, hi = (p * c) // n, ((p + 1) * c) // n
+    ex_lo, ex_hi = int(starts[lo]), int(starts[hi])
+    owned = fed.train_x.owned_shard_range(ex_lo, ex_hi)
+    for arr in (fed.train_x, fed.train_y):
+        arr.set_shard_ownership(owned, replica_fallback=replica_fallback)
+    return {
+        "process_index": int(p),
+        "process_count": int(n),
+        "clients": [int(lo), int(hi)],
+        "owned_shards": [owned.start, owned.stop],
+    }
+
+
 def _mask_from_spec(spec, steps: int, batch_local: int, local_epochs: int,
                     batch_total: int, batch_offset):
     """Rebuild the ``[C, steps, batch]`` float32 validity mask from the

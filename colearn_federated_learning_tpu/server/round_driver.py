@@ -29,6 +29,7 @@ from colearn_federated_learning_tpu.data.loader import (
     bucket_ladder,
     compute_round_shape,
     eval_batches,
+    iter_client_slabs,
     make_round_indices,
     make_round_spec,
     pick_bucket,
@@ -57,6 +58,7 @@ from colearn_federated_learning_tpu.obs.roofline import (
 )
 from colearn_federated_learning_tpu.parallel import mesh as mesh_lib
 from colearn_federated_learning_tpu.parallel.round_engine import (
+    apply_store_shard_ownership,
     make_async_round_fn,
     make_sequential_round_fn,
     make_sharded_round_fn,
@@ -697,8 +699,13 @@ class Experiment:
         )
         self._db_stats = {
             "host_prefetched": 0, "placed_prefetched": 0,
-            "prefetch_dropped": 0,
+            "prefetch_dropped": 0, "slab_prefetched": 0,
         }
+        # fused chunk-union slab prefetch (stream × fuse): one future
+        # per upcoming chunk, keyed by chunk start round — submitted
+        # right before the current chunk's dispatch so the next
+        # chunk's store gather runs while this dispatch executes
+        self._chunk_prefetch: Dict[int, Any] = {}
         _warn_bf16_backend(cfg)
         if self._stream:
             rows_per_round = (
@@ -716,7 +723,14 @@ class Experiment:
             )
             self.train_x = None
             self.train_y = None
+            # multi-host shard ownership (store-backed pods): each
+            # process marks the store shards whose clients land on its
+            # contiguous client block, so steady-state gathers fault
+            # only local pages; off-block touches fall back to read
+            # replicas (counted in gather_stats) — see round_engine
+            self._store_ownership = apply_store_shard_ownership(self.fed)
         else:
+            self._store_ownership = None
             self.train_x = put(jnp.asarray(self.fed.train_x))
             self.train_y = put(jnp.asarray(self.fed.train_y))
         # Device control plane: build the static plan (cohort table via
@@ -1905,6 +1919,76 @@ class Experiment:
         return {"spe": spe, "host": (cohort, idx, mask, n_ex, slab),
                 "placed": placed}
 
+    def _build_chunk_slab_entry(self, start: int, fuse: int,
+                                spe: Optional[int]) -> Optional[Dict[str, Any]]:
+        """Worker-thread body for the fused chunk-union slab (stream ×
+        fuse overlap): stack the chunk's index grids — reusing the
+        per-round prefetch entries, which the one-worker executor's
+        FIFO order guarantees already completed; _host_inputs is pure
+        in (seed, round), so rebuilding any missing one is bitwise
+        harmless — dedup into the union row set, and run the store
+        gather (the expensive mmap I/O) off the critical path. The
+        consumer verifies the row set against its own stack and drains
+        on any mismatch, so a wrong-shape build can never smuggle
+        wrong bytes into a dispatch."""
+        shape = self._bucket_shape(spe) if spe is not None else None
+        idxs = []
+        for t in range(start, start + fuse):
+            entry = None
+            fut = self._prefetch.get(t)
+            if fut is not None:
+                entry = fut.result()
+            if entry is not None and entry["spe"] == spe:
+                idxs.append(entry["host"][1])
+            else:
+                _c, idx, _m, _n, _s = self._host_inputs(
+                    t, shape=shape, build_slab=False
+                )
+                idxs.append(idx)
+        uniq = np.unique(np.stack(idxs))
+        rows = self._fused_slab_rows
+        if len(uniq) > rows:
+            # overflow is the consumer's error to raise (same message,
+            # its own stack); an over-full prefetched slab is just drained
+            return None
+        slab_x = np.empty((rows,) + self.fed.train_x.shape[1:],
+                          self.fed.train_x.dtype)
+        slab_y = np.empty((rows,) + self.fed.train_y.shape[1:],
+                          self.fed.train_y.dtype)
+        slab_x[: len(uniq)] = self.fed.train_x[uniq]
+        slab_y[: len(uniq)] = self.fed.train_y[uniq]
+        return {"spe": spe, "fuse": fuse, "uniq": uniq,
+                "slab_x": slab_x, "slab_y": slab_y}
+
+    def _submit_chunk_slab_prefetch(self, round_idx: int, fuse: int) -> None:
+        """Queue the NEXT chunk's union-slab store gather on the host
+        worker — called right before this chunk's dispatch, so the
+        gather I/O runs while the device executes and the next
+        ``round.stream_slab`` span collapses to a verify+remap. The
+        next chunk's per-round host builds are already queued ahead of
+        it (FIFO), so the slab builder reuses their index grids. The
+        ledger-snapshot refresh boundary rule from _maybe_prefetch
+        applies chunk-wholesale: a chunk past the boundary is a
+        function of a snapshot that does not exist yet."""
+        if (not self._stream or not self._double_buffer
+                or self._native is not None):
+            return
+        start = round_idx + fuse
+        if (start >= self.cfg.server.num_rounds
+                or start in self._chunk_prefetch):
+            return
+        if self._snapshot_refresh:
+            le = self._ledger_cfg.log_every
+            if le and (start + fuse - 1) // le != round_idx // le:
+                return
+        ex = self._ensure_executor()
+        if ex is None:
+            return
+        self._chunk_prefetch[start] = ex.submit(
+            self._build_chunk_slab_entry, start, fuse,
+            self._prefetch_spe(start),
+        )
+
     def _ensure_executor(self):
         if self._host_executor is None and (
             self._double_buffer or self._stream
@@ -1924,9 +2008,13 @@ class Experiment:
         while round_idx's dispatched compute executes — the second
         in-flight placed-slab buffer. Under fuse_rounds the whole next
         chunk's host slabs build ahead (placement stays with the chunk
-        stacker); stream mode keeps its legacy build-only single
-        look-ahead (a placed slab would double the bounded-memory
-        promise). The adaptive sampler never prefetches across a
+        stacker, and the chunk-union STORE GATHER runs ahead through
+        _build_chunk_slab_entry); double-buffered stream mode builds
+        AND places the next round's slab ahead too — the second
+        in-flight slab is the overlap buffer, the deliberate +1-slab
+        cost of hiding the store gather under dispatch (legacy
+        non-double-buffered stream keeps the build-only single
+        look-ahead). The adaptive sampler never prefetches across a
         ledger-snapshot refresh boundary — the cohort there is a
         function of a snapshot that does not exist yet."""
         ex = self._ensure_executor()
@@ -1950,9 +2038,7 @@ class Experiment:
                 le = self._ledger_cfg.log_every
                 if le and t // le != round_idx // le:
                     continue
-            place = (
-                self._double_buffer and not self._stream and fuse == 1
-            )
+            place = self._double_buffer and fuse == 1
             self._prefetch[t] = ex.submit(
                 self._build_prefetch_entry, t, self._prefetch_spe(t), place
             )
@@ -3273,16 +3359,38 @@ class Experiment:
                         self._population.observe_slab(
                             int(idx_stack.size), int(len(uniq))
                         )
-                    slab_x = np.empty(
-                        (rows,) + self.fed.train_x.shape[1:],
-                        self.fed.train_x.dtype,
-                    )
-                    slab_y = np.empty(
-                        (rows,) + self.fed.train_y.shape[1:],
-                        self.fed.train_y.dtype,
-                    )
-                    slab_x[: len(uniq)] = self.fed.train_x[uniq]
-                    slab_y[: len(uniq)] = self.fed.train_y[uniq]
+                    # overlapped chunk gather: the PREVIOUS chunk queued
+                    # this chunk's union-slab build before its dispatch,
+                    # so the mmap I/O ran under device compute. Adopt it
+                    # only if the row set matches bitwise what we just
+                    # stacked (a cheap np.array_equal vs the expensive
+                    # gather) — any mismatch (rung drift, resume seam)
+                    # drains to the synchronous build below.
+                    for stale in [k for k in self._chunk_prefetch
+                                  if k < round_idx]:
+                        self._chunk_prefetch.pop(stale).cancel()
+                    pre = self._chunk_prefetch.pop(round_idx, None)
+                    entry = pre.result() if pre is not None else None
+                    if (entry is not None
+                            and entry["spe"] == self._prefetch_spe(round_idx)
+                            and entry["fuse"] == fuse
+                            and np.array_equal(entry["uniq"], uniq)):
+                        slab_x = entry["slab_x"]
+                        slab_y = entry["slab_y"]
+                        self._db_stats["slab_prefetched"] += 1
+                    else:
+                        if pre is not None:
+                            self._db_stats["prefetch_dropped"] += 1
+                        slab_x = np.empty(
+                            (rows,) + self.fed.train_x.shape[1:],
+                            self.fed.train_x.dtype,
+                        )
+                        slab_y = np.empty(
+                            (rows,) + self.fed.train_y.shape[1:],
+                            self.fed.train_y.dtype,
+                        )
+                        slab_x[: len(uniq)] = self.fed.train_x[uniq]
+                        slab_y[: len(uniq)] = self.fed.train_y[uniq]
                     idx_stack = inv.reshape(idx_stack.shape).astype(np.int32)
                 train_x = self._put_data(jnp.asarray(slab_x))
                 train_y = self._put_data(jnp.asarray(slab_y))
@@ -3332,6 +3440,10 @@ class Experiment:
                 cohorts_f = self._put(cohort_rows, self._data_sharding)
         common = (state["params"], state["server_opt_state"], train_x,
                   train_y, idx_f, mask_f, n_ex_f, rngs_f)
+        # queue the NEXT chunk's union-slab store gather before this
+        # chunk's dispatch — the I/O overlaps device compute (tentpole
+        # of the store data plane: slab_build collapses under dispatch)
+        self._submit_chunk_slab_prefetch(round_idx, fuse)
         ledger = None
         with self._bucket_compile_span(round_idx, int(idx_f.shape[2])), \
                 self.tracer.span("round.dispatch", fuse=fuse):
@@ -3388,6 +3500,9 @@ class Experiment:
         for fut in self._prefetch.values():
             fut.cancel()
         self._prefetch.clear()
+        for fut in self._chunk_prefetch.values():
+            fut.cancel()
+        self._chunk_prefetch.clear()
         if ex is not None:
             ex.shutdown(wait=True, cancel_futures=True)
 
@@ -4820,15 +4935,22 @@ class Experiment:
             + 4  # mask f32
         )
         chunk = max(1, min(len(eligible), (512 << 20) // max(bytes_per_client, 1)))
+        # per-client rows stream through iter_client_slabs: under a
+        # store backend consecutive client ids coalesce into bounded
+        # contiguous-range gathers (eval_buffer_mb) instead of one
+        # transient arange materialization per client — bitwise the
+        # same bytes as the in-memory fancy-index (test-pinned in
+        # tests/test_store_data_plane.py)
+        eval_buf = self.cfg.data.store.eval_buffer_mb << 20
         cs, ns = [], []
         for lo in range(0, len(eligible), chunk):
             part = [
-                eval_batches(
-                    self.fed.train_x[np.asarray(self.fed.client_indices[cid])],
-                    self.fed.train_y[np.asarray(self.fed.client_indices[cid])],
-                    batch,
+                eval_batches(cx, cy, batch)
+                for _cid, cx, cy in iter_client_slabs(
+                    self.fed.train_x, self.fed.train_y,
+                    self.fed.client_indices, eligible[lo:lo + chunk],
+                    eval_buf,
                 )
-                for cid in eligible[lo:lo + chunk]
             ]
             xs, ys, ms = (
                 np.stack([pad(t[i]) for t in part]) for i in range(3)
@@ -4899,11 +5021,21 @@ class Experiment:
             ))
 
         pers, base = [], []
-        for cid in eligible:
-            ids = rng.permutation(np.asarray(self.fed.client_indices[cid]))
-            n_hold = min(max(1, int(round(holdout_frac * len(ids)))),
-                         len(ids) - 1)
-            hold, train = ids[:n_hold], ids[n_hold:]
+        # clients stream through iter_client_slabs (store-coalesced
+        # contiguous gathers, bounded by eval_buffer_mb); the
+        # holdout/train split permutes LOCAL positions into each
+        # client's natural-order slab — rng.permutation(n) consumes the
+        # generator identically to the former rng.permutation(ids)
+        # (Fisher–Yates swaps are index-based), and cx[perm] is the
+        # same bytes, so splits/batch order/metrics stay bitwise
+        for cid, cx, cy in iter_client_slabs(
+            self.fed.train_x, self.fed.train_y, self.fed.client_indices,
+            eligible, self.cfg.data.store.eval_buffer_mb << 20,
+        ):
+            perm = rng.permutation(len(cx))
+            n_hold = min(max(1, int(round(holdout_frac * len(perm)))),
+                         len(perm) - 1)
+            hold, train = perm[:n_hold], perm[n_hold:]
             if len(train) > cap:
                 train = train[:cap]
             n = len(train)
@@ -4916,8 +5048,8 @@ class Experiment:
                 idx[off : off + n] = rng.permutation(n).astype(np.int32)
                 mask[off : off + n] = 1.0
             pad = cap - n
-            slab_x = self.fed.train_x[train]
-            slab_y = self.fed.train_y[train]
+            slab_x = cx[train]
+            slab_y = cy[train]
             if pad:
                 slab_x = np.concatenate(
                     [slab_x, np.repeat(slab_x[:1], pad, axis=0)]
@@ -4935,9 +5067,7 @@ class Experiment:
                 jax.random.fold_in(jax.random.PRNGKey(seed), cid),
                 *extra,
             )
-            xb, yb, mb = eval_batches(
-                self.fed.train_x[hold], self.fed.train_y[hold], batch
-            )
+            xb, yb, mb = eval_batches(cx[hold], cy[hold], batch)
             accs = {}
             for tag, p in (("personalized", p_i), ("baseline", params)):
                 c_sum = n_sum = 0.0

@@ -200,10 +200,12 @@ def test_run_summary_records_prefetch_stats(tmp_path):
     assert phases["round.placement"] < phases["round.dispatch"]
 
 
-def test_fedbuff_and_stream_keep_legacy_behavior(tmp_path):
-    """fedbuff's queue scheduler is not buffered; stream placement
-    keeps its one-ahead build-only prefetch (no placed slabs — the
-    bounded-memory promise)."""
+def test_fedbuff_and_stream_keep_contract(tmp_path):
+    """fedbuff's queue scheduler is not buffered. Double-buffered
+    stream placement builds AND places the next slab ahead (PR 19's
+    gather/upload overlap — still O(cohort) slabs, one extra in
+    flight); legacy non-double-buffered stream keeps the one-ahead
+    build-only prefetch. Both bitwise-equal the serial run."""
     cfg = _cfg(True, rounds=4, **{
         "algorithm": "fedbuff", "client.momentum": 0.0,
     })
@@ -213,7 +215,11 @@ def test_fedbuff_and_stream_keep_legacy_behavior(tmp_path):
 
     scfg = _cfg(True, rounds=4, **{"data.placement": "stream"})
     sexp, s_on = _fit(scfg)
-    assert sexp._db_stats["placed_prefetched"] == 0  # build-only
-    assert sexp._db_stats["host_prefetched"] > 0
-    _, s_off = _fit(_cfg(False, rounds=4, **{"data.placement": "stream"}))
+    assert sexp._db_stats["placed_prefetched"] == 3  # rounds 1..3 ahead
+    assert sexp._db_stats["host_prefetched"] == 3
+    soff_exp, s_off = _fit(
+        _cfg(False, rounds=4, **{"data.placement": "stream"})
+    )
+    assert soff_exp._db_stats["placed_prefetched"] == 0  # build-only
+    assert soff_exp._db_stats["host_prefetched"] > 0
     _params_equal(s_on["params"], s_off["params"])

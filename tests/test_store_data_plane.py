@@ -1,0 +1,375 @@
+"""Throughput-first store data plane (PR 19).
+
+Four contracts, all bitwise:
+
+- **Sharded gather pool** (`data.store.gather_workers`): a slab's row
+  set splits by owning shard and the per-shard copies run concurrently —
+  disjoint destination rows make the output identical for every worker
+  count and completion order, so parallelism changes wall time, never
+  bytes. Counter snapshots (`gather_stats()`) are consistent under
+  concurrent gathers and never touch the data path's locks.
+- **Compute-overlapped slab pipeline**: under `run.double_buffer` the
+  NEXT round's (and, fused, the next CHUNK'S union) store gather runs on
+  the host worker while the current dispatch executes; the consumer
+  verifies the prefetched row set and drains on any mismatch — through a
+  fused chunk boundary, an unaligned resume's catch-up, and a
+  ledger-snapshot refresh boundary — so overlapped ≡ serial-gather
+  bitwise.
+- **Store-backed eval**: federated/personalized evaluation streams
+  client rows through `iter_client_slabs` (consecutive clients coalesce
+  into bounded contiguous-range gathers) instead of transient per-client
+  arange materialization — metrics equal the in-memory twin's exactly.
+- **Multi-host shard ownership**: contiguous client ids make each
+  process's owned shard range a pure function of shard start offsets;
+  read-replica fallback keeps non-owned touches correct (and counted).
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.data import build_federated_data
+from colearn_federated_learning_tpu.data.loader import iter_client_slabs
+from colearn_federated_learning_tpu.data.store import (
+    open_store,
+    resolve_gather_workers,
+    write_store,
+)
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _data_cfg(**over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.apply_overrides({
+        "data.num_clients": 8, "server.cohort_size": 4,
+        "server.num_rounds": 4, "server.eval_every": 0,
+        "data.synthetic_train_size": 512, "data.synthetic_test_size": 64,
+        "data.max_examples_per_client": 64,
+        "run.host_pipeline": "numpy",
+        "run.out_dir": "",
+    })
+    if over:
+        cfg.apply_overrides(over)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def store_dir(tmp_path_factory):
+    """One converted multi-shard store for the whole module (0.1 MB
+    shards over a ~0.4 MB corpus — gathers genuinely span shards)."""
+    cfg = _data_cfg()
+    fed = build_federated_data(cfg.data, seed=cfg.run.seed)
+    out = tmp_path_factory.mktemp("store") / "s"
+    write_store(str(out), fed, shard_mb=0.1)
+    return str(out)
+
+
+def _params_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded gather pool: determinism + stats
+# ---------------------------------------------------------------------------
+
+
+def test_gather_pool_bitwise_at_every_worker_count(store_dir):
+    """workers ∈ {1, 4} (and auto) must produce identical slabs for an
+    unordered, duplicated, all-shard-spanning row set."""
+    rng = np.random.default_rng(0)
+    n = len(open_store(store_dir).x)
+    ids = rng.integers(0, n, 300)  # duplicates + arbitrary order
+    slabs = {}
+    for w in (1, 4, 0):
+        st = open_store(store_dir, gather_workers=w)
+        assert st.x._workers == resolve_gather_workers(w)
+        slabs[w] = (st.x.gather(ids), st.y.gather(ids))
+    np.testing.assert_array_equal(slabs[1][0], slabs[4][0])
+    np.testing.assert_array_equal(slabs[1][1], slabs[4][1])
+    np.testing.assert_array_equal(slabs[1][0], slabs[0][0])
+    # the pooled run actually fanned out (multi-shard store, workers>1)
+    st4 = open_store(store_dir, gather_workers=4)
+    st4.x.gather(ids)
+    s = st4.x.gather_stats()
+    assert s["workers"] == 4 and s["pool_gathers"] == 1
+    assert s["rows"] == 300 and s["io_ms"] >= 0.0
+    # order within the output follows the REQUEST order, not shard order
+    one = open_store(store_dir, gather_workers=4).x
+    np.testing.assert_array_equal(
+        one.gather(ids[::-1]), slabs[1][0][::-1]
+    )
+
+
+def test_gather_workers_validation_and_auto():
+    assert resolve_gather_workers(3) == 3
+    assert 1 <= resolve_gather_workers(0) <= 4
+    cfg = _data_cfg(**{"data.store.gather_workers": -1})
+    with pytest.raises(ValueError, match="gather_workers"):
+        cfg.validate()
+    cfg = _data_cfg(**{"data.store.eval_buffer_mb": 0})
+    with pytest.raises(ValueError, match="eval_buffer_mb"):
+        cfg.validate()
+
+
+def test_gather_stats_consistent_under_concurrent_gathers(store_dir):
+    """The satellite bugfix pin: counters fold under a dedicated stats
+    lock (one short acquisition per gather, outside the data path), so
+    concurrent gathers from the fit thread, the prefetch worker, and
+    the pool never tear a snapshot — totals add up exactly."""
+    st = open_store(store_dir, gather_workers=4)
+    n = len(st.x)
+    errs = []
+
+    def hammer(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            for _ in range(20):
+                st.x.gather(rng.integers(0, n, 64))
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    s = st.x.gather_stats()
+    assert s["calls"] == 80 and s["rows"] == 80 * 64
+    assert s["bytes"] == s["rows"] * 28 * 28
+    assert s["shard_touches"].sum() >= s["pool_gathers"]
+
+
+# ---------------------------------------------------------------------------
+# store-backed eval: iter_client_slabs + driver parity
+# ---------------------------------------------------------------------------
+
+
+def test_iter_client_slabs_bitwise_and_coalesced(store_dir):
+    cfg = _data_cfg()
+    fed = build_federated_data(cfg.data, seed=cfg.run.seed)
+    sfed = open_store(store_dir, gather_workers=4).as_federated_data(
+        expected_clients=8
+    )
+    # mixed request: a consecutive run, a gap, a backwards jump
+    req = [1, 2, 3, 6, 0, 7]
+    mem = list(iter_client_slabs(fed.train_x, fed.train_y,
+                                 fed.client_indices, req, 1 << 30))
+    calls0 = sfed.train_x.gather_stats()["calls"]
+    st = list(iter_client_slabs(sfed.train_x, sfed.train_y,
+                                sfed.client_indices, req, 1 << 30))
+    coalesced = sfed.train_x.gather_stats()["calls"] - calls0
+    assert [c for c, _, _ in mem] == req == [c for c, _, _ in st]
+    for (_, mx, my), (_, sx, sy) in zip(mem, st):
+        np.testing.assert_array_equal(mx, sx)
+        np.testing.assert_array_equal(my, sy)
+    # 1→2→3 coalesce into ONE contiguous gather; 6, 0, 7 break runs
+    assert coalesced == 4
+    # a 1-record budget forces per-client flushes — bytes still equal
+    tiny = list(iter_client_slabs(sfed.train_x, sfed.train_y,
+                                  sfed.client_indices, req, 1))
+    for (_, mx, my), (_, sx, sy) in zip(mem, tiny):
+        np.testing.assert_array_equal(mx, sx)
+        np.testing.assert_array_equal(my, sy)
+
+
+# sequential×fuse>1 is invalid by config; the valid eval matrix cells
+_EVAL_MATRIX = [("sharded", 1), ("sharded", 4), ("sequential", 1)]
+
+
+@pytest.mark.parametrize("engine,fuse", _EVAL_MATRIX)
+def test_store_backed_eval_equals_in_memory(store_dir, engine, fuse):
+    """evaluate_federated / evaluate_personalized stream through the
+    store shard-by-shard yet report EXACTLY the in-memory twin's
+    numbers — same rng stream (local-position permutations), same
+    bytes, same floats."""
+    cfg = _data_cfg(**{"run.engine": engine, "run.fuse_rounds": fuse})
+    cfg.validate()
+    mem = Experiment(cfg, echo=False)
+    m_state = mem.fit()
+    cfg = _data_cfg(**{
+        "run.engine": engine, "run.fuse_rounds": fuse,
+        "data.store.dir": store_dir, "data.placement": "stream",
+        "data.store.gather_workers": 4,
+    })
+    cfg.validate()
+    st = Experiment(cfg, echo=False)
+    s_state = st.fit()
+    _params_equal(m_state["params"], s_state["params"])
+    for kwargs in ({"max_clients": 5, "seed": 3}, {"seed": 3}):
+        fm = mem.evaluate_federated(m_state["params"], **kwargs)
+        fs = st.evaluate_federated(s_state["params"], **kwargs)
+        assert fm == fs
+    pm = mem.evaluate_personalized(m_state["params"], max_clients=4, seed=3)
+    ps = st.evaluate_personalized(s_state["params"], max_clients=4, seed=3)
+    assert pm == ps
+    assert pm["personalized_clients"] == 4
+    # the eval path went through the store gather, not materialization
+    assert st.fed.train_x.gather_stats()["calls"] > 0
+
+
+def test_eval_buffer_size_never_changes_bytes(store_dir):
+    """eval_buffer_mb bounds reassembly memory; shrinking it to the
+    floor must not move a single metric float."""
+    outs = []
+    for buf in (256, 1):
+        cfg = _data_cfg(**{
+            "data.store.dir": store_dir, "data.placement": "stream",
+            "data.store.eval_buffer_mb": buf,
+        })
+        cfg.validate()
+        exp = Experiment(cfg, echo=False)
+        params = exp._place_state(exp.init_state())["params"]
+        outs.append((
+            exp.evaluate_federated(params, seed=1),
+            exp.evaluate_personalized(params, max_clients=3, seed=1),
+        ))
+    assert outs[0] == outs[1]
+
+
+# ---------------------------------------------------------------------------
+# compute-overlapped slab pipeline: overlapped ≡ serial-gather bitwise
+# ---------------------------------------------------------------------------
+
+
+def _store_cfg(store_dir, rounds, fuse, db, workers, **over):
+    return _data_cfg(**{
+        "server.num_rounds": rounds, "run.fuse_rounds": fuse,
+        "run.double_buffer": db,
+        "data.store.dir": store_dir, "data.placement": "stream",
+        "data.store.gather_workers": workers,
+        **over,
+    })
+
+
+def _fit(cfg):
+    cfg.validate()
+    exp = Experiment(cfg, echo=False)
+    return exp, exp.fit()
+
+
+def test_overlapped_stream_places_ahead_and_stays_bitwise(store_dir):
+    """fuse=1 stream × double_buffer: slab gather AND device placement
+    run ahead on the worker; serial baseline (no overlap, one worker)
+    is the bitwise reference."""
+    on_exp, on = _fit(_store_cfg(store_dir, 4, 1, True, 4))
+    off_exp, off = _fit(_store_cfg(store_dir, 4, 1, False, 1))
+    _params_equal(on["params"], off["params"])
+    assert on_exp._db_stats["placed_prefetched"] == 3
+    assert on_exp._db_stats["prefetch_dropped"] == 0
+    assert off_exp._db_stats["placed_prefetched"] == 0
+
+
+def test_overlapped_fused_chunk_slab_pins_through_boundary(store_dir):
+    """fuse=4 stream × double_buffer: each chunk queues the NEXT
+    chunk's union-slab gather before dispatching; the consumer adopts
+    it only after matching the row set bitwise. 8 rounds = 2 chunks →
+    exactly one prefetched chunk slab, zero drains, params equal the
+    serial-gather run AND the unfused run."""
+    on_exp, on = _fit(_store_cfg(store_dir, 8, 4, True, 4))
+    _, off = _fit(_store_cfg(store_dir, 8, 4, False, 1))
+    _, plain = _fit(_store_cfg(store_dir, 8, 1, False, 1))
+    _params_equal(on["params"], off["params"])
+    _params_equal(on["params"], plain["params"])
+    assert on_exp._db_stats["slab_prefetched"] == 1
+    assert on_exp._db_stats["prefetch_dropped"] == 0
+    assert on_exp._chunk_prefetch == {}
+
+
+def test_overlapped_unaligned_resume_drains_and_matches(store_dir):
+    """A warm start off the chunk grid dispatches a fuse=1 catch-up
+    round; the overlap must drain (never feed a chunk-built slab to the
+    catch-up, or vice versa) and the resumed run still equals the
+    straight overlapped run bitwise."""
+    _, straight = _fit(_store_cfg(store_dir, 4, 2, True, 4))
+    cfg = _store_cfg(store_dir, 4, 2, True, 4)
+    cfg.validate()
+    exp = Experiment(cfg, echo=False)
+    state = exp._place_state(exp.init_state())
+    state = exp.run_round(state, 0, fuse_override=1)
+    state.pop("_metrics")
+    cfg2 = _store_cfg(store_dir, 4, 2, True, 4)
+    cfg2.validate()
+    exp2 = Experiment(cfg2, echo=False)
+    resumed = exp2.fit(state)
+    _params_equal(straight["params"], resumed["params"])
+
+
+def test_overlapped_chunk_skips_snapshot_refresh_boundary(store_dir):
+    """The ledger-snapshot refresh rule applies to chunk slabs
+    wholesale: a next-chunk gather crossing a log_every boundary is a
+    function of a snapshot that does not exist yet, so it is never
+    queued — and the run stays bitwise the serial one. 16 rounds,
+    fuse=4, log_every=8: the chunk at 8 crosses (skipped), the chunks
+    at 4 and 12 do not (prefetched)."""
+    over = {
+        "server.sampling": "streaming",
+        "run.obs.client_ledger.enabled": True,
+        "run.obs.client_ledger.log_every": 8,
+        "run.obs.client_ledger.hot_capacity": 64,
+    }
+    on_exp, on = _fit(_store_cfg(store_dir, 16, 4, True, 4, **over))
+    _, off = _fit(_store_cfg(store_dir, 16, 4, False, 1, **over))
+    _params_equal(on["params"], off["params"])
+    assert on_exp._db_stats["slab_prefetched"] == 2
+    assert on_exp._db_stats["prefetch_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-host shard ownership (single-process: the pure arithmetic + replica)
+# ---------------------------------------------------------------------------
+
+
+def test_process_ownership_partitions_and_replicates(store_dir):
+    st = open_store(store_dir)
+    shards = st.describe()["num_shards"]
+    # every process computes every block identically; blocks partition
+    blocks = [st.process_client_block(p, 3) for p in range(3)]
+    assert [c for b in blocks for c in b] == list(range(st.num_clients))
+    owned_union = []
+    for p in range(3):
+        info = open_store(store_dir).apply_process_ownership(p, 3)
+        lo, hi = info["owned_shards"]
+        assert info["process_index"] == p and 0 <= lo <= hi <= shards
+        owned_union.extend(range(lo, hi))
+    # contiguous ranges cover every shard (boundary shards may be
+    # shared between neighbours — clients never span shards, blocks do)
+    assert set(owned_union) == set(range(shards))
+    with pytest.raises(ValueError, match="process_index"):
+        st.apply_process_ownership(5, 3)
+
+
+def test_replica_fallback_counts_and_strict_mode_raises(store_dir):
+    # owner of the FIRST client block gathers a LAST-block row: the
+    # replica fallback serves it (correctness everywhere) and counts it
+    st = open_store(store_dir)
+    st.apply_process_ownership(0, 4, replica_fallback=True)
+    last = len(st.x) - 1
+    row = st.x.gather([last])
+    np.testing.assert_array_equal(
+        row, open_store(store_dir).x.gather([last])
+    )
+    assert st.x.gather_stats()["replica_rows"] == 1
+    # strict mode: the same touch raises with the shard named
+    st2 = open_store(store_dir)
+    st2.apply_process_ownership(0, 4, replica_fallback=False)
+    with pytest.raises(RuntimeError, match="not owned"):
+        st2.x.gather([last])
+    # owned rows still gather fine in strict mode
+    st2.x.gather([0])
+
+
+def test_single_process_fit_applies_no_ownership(store_dir):
+    cfg = _store_cfg(store_dir, 4, 1, True, 2)
+    cfg.validate()
+    exp = Experiment(cfg, echo=False)
+    assert exp._store_ownership is None  # jax.process_count() == 1
+    assert exp.fed.train_x._owned is None
