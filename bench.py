@@ -347,30 +347,43 @@ def bench_config(name: str):
     # each dispatch executes exactly `fuse` rounds — misaligned shape
     # constants would silently mis-count rounds_per_sec
     assert warmup % fuse == 0 and timed % fuse == 0, (name, warmup, timed, fuse)
-    for r in range(0, warmup, fuse):
-        state = exp.run_round(state, r)
-        m = state.pop("_metrics")
-        last_loss = float(
-            m.train_loss if fuse == 1 else m.train_loss[-1]
-        )
+    # the executable registry intercepts lowerings only while installed
+    # (fit() does this for real runs); bench drives run_round directly,
+    # so install around the round loops to get the HLO-derived flop
+    # truth behind the flop_model_drift_pct extra — production runs
+    # have it on too, so the timed region stays representative
+    from colearn_federated_learning_tpu.obs import executables as _exec_mod
 
-    # reset the phase-span aggregates so the breakdown below covers the
-    # TIMED region only (the warmup window holds the compiles)
-    exp.tracer.drain()
-    t0 = time.perf_counter()
-    pending = []
-    for r in range(warmup, warmup + timed, fuse):
-        state = exp.run_round(state, r)
-        m = state.pop("_metrics")
-        if fuse == 1:
-            pending.append(m)
-        else:
-            pending.extend(
-                jax.tree.map(lambda a, j=j: a[j], m) for j in range(fuse)
+    if exp._exec_reg is not None:
+        _exec_mod.install(exp._exec_reg)
+    try:
+        for r in range(0, warmup, fuse):
+            state = exp.run_round(state, r)
+            m = state.pop("_metrics")
+            last_loss = float(
+                m.train_loss if fuse == 1 else m.train_loss[-1]
             )
-    fetched = jax.device_get(pending)
-    last_loss = float(fetched[-1].train_loss)
-    dt = time.perf_counter() - t0
+
+        # reset the phase-span aggregates so the breakdown below covers
+        # the TIMED region only (the warmup window holds the compiles)
+        exp.tracer.drain()
+        t0 = time.perf_counter()
+        pending = []
+        for r in range(warmup, warmup + timed, fuse):
+            state = exp.run_round(state, r)
+            m = state.pop("_metrics")
+            if fuse == 1:
+                pending.append(m)
+            else:
+                pending.extend(
+                    jax.tree.map(lambda a, j=j: a[j], m) for j in range(fuse)
+                )
+        fetched = jax.device_get(pending)
+        last_loss = float(fetched[-1].train_loss)
+        dt = time.perf_counter() - t0
+    finally:
+        if exp._exec_reg is not None:
+            _exec_mod.uninstall()
 
     rounds_per_sec = timed / dt
     updates_per_sec_per_chip = (
@@ -403,11 +416,25 @@ def bench_config(name: str):
     # host_exposed_pct_max — host spans the device idles through,
     # over the timed region's wall clock
     hep = _host_exposed_pct(phase_ms, dt)
+    # measured-vs-analytic flop drift (run.obs.executables): the XLA
+    # cost_analysis flops of the dominant compiled round program vs the
+    # analytic model — None (n/a in bench-report) when the registry is
+    # off or the backend reports no cost analysis, gated against
+    # flop_drift_pct_max
+    drift_pct = None
+    reg = getattr(exp, "_exec_reg", None)
+    if reg is not None and flops_per_round:
+        measured = reg.measured_round_flops()
+        if measured is not None:
+            drift_pct = round(
+                100.0 * (measured[1] - flops_per_round) / flops_per_round, 2
+            )
     extra = {
         "static_check": _static_check_extra(),
         "vs_baseline_basis": vs_basis,
         "phase_ms": phase_ms,
         "host_exposed_pct": None if hep is None else round(hep, 2),
+        "flop_model_drift_pct": drift_pct,
         "client_updates_per_sec_per_chip": round(updates_per_sec_per_chip, 4),
         "n_chips": exp.n_chips,
         "timed_rounds": timed,

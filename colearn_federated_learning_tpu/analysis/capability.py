@@ -198,6 +198,13 @@ FEATURES: Dict[str, Feature] = {
         "device-resident control plane (server/device_plane.py): "
         "cohort/churn/slab derivation lowered into the round program; "
         "driver-level — the engines run unchanged under the wrapper"),
+    "executables": Feature(
+        {"run.obs.executables": True}, False,
+        "compiled-program observatory (obs/executables.py): AOT "
+        "lower/compile registry harvesting XLA cost/memory analysis, "
+        "HBM watermarks and retrace forensics; observational like "
+        "digest — the lowering is the one jit would produce, params "
+        "are bitwise identical with it off"),
 }
 
 

@@ -123,6 +123,8 @@ REGISTRY: Dict[str, RecordSpec] = {
             "version_readmitted", "hier_edges", "hier_edge_absorbed",
             "hier_edge_crashed", "hier_edge_excluded",
             "hier_core_upload_bytes",
+            # compiled-program observatory (PR 20): run HBM peak
+            "hbm_peak_bytes", "hbm_peak_program", "executables_compiled",
         ),
         doc="end-of-fit totals (every exit path, aborts included)",
     ),
@@ -225,6 +227,28 @@ REGISTRY: Dict[str, RecordSpec] = {
         doc="checkpoint digest-head vs log chain verification at resume "
             "(run.obs.digest.verify_resume)",
     ),
+    "executable_compiled": RecordSpec(
+        required=("round", "name", "fingerprint", "compile_ms"),
+        optional=("flops", "bytes_accessed", "argument_bytes",
+                  "output_bytes", "temp_bytes", "generated_code_bytes",
+                  "peak_bytes", "donated_args", "rounds_per_call",
+                  "backend", "preflight"),
+        doc="per-compiled-program XLA cost/memory truth "
+            "(obs/executables.py; run.obs.executables)",
+    ),
+    "retrace": RecordSpec(
+        required=("round", "name", "fingerprint", "prev_fingerprint",
+                  "n_changed", "changed"),
+        doc="recompile forensics: which argument of an already-seen "
+            "program changed shape/dtype/sharding",
+    ),
+    "hbm_watermark": RecordSpec(
+        required=("round", "watermark_bytes"),
+        optional=("program", "resident_bytes", "temp_bytes", "programs",
+                  "peak_bytes"),
+        doc="per-flush predicted HBM high-water mark over the window's "
+            "dispatched programs (+ running run peak)",
+    ),
 }
 
 # modules whose logger.log(...) calls are emit sites (repo-root relative)
@@ -236,6 +260,7 @@ EMIT_LOG_MODULES = (
 EVENT_DICT_MODULES = (
     "colearn_federated_learning_tpu/obs/health.py",
     "colearn_federated_learning_tpu/obs/population.py",
+    "colearn_federated_learning_tpu/obs/executables.py",
 )
 # the pure-host report modules `colearn summarize/watch/mfu/population/
 # clients` run (bench-report reads BENCH_r*.json, a different artifact)

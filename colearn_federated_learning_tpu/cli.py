@@ -359,6 +359,22 @@ def build_parser():
                     dest="replay_round",
                     help="digest round to replay (a round carrying a "
                          "round_digest record)")
+
+    pf = sub.add_parser(
+        "preflight",
+        help="OOM preflight (run.obs.executables, obs/executables.py): "
+             "lower + compile every round program abstractly — no real "
+             "buffers bound, nothing executed — and report each "
+             "program's predicted peak HBM (arguments + outputs + XLA "
+             "temp high-water) against run.obs.hbm_budget_mb and the "
+             "device capacity, naming the dominant buffers — exit 1 "
+             "when over budget, 2 when the config cannot be "
+             "preflighted (sequential engine)",
+    )
+    _add_common(pf)
+    pf.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object instead "
+                         "of the table")
     return p
 
 
@@ -652,6 +668,10 @@ def main(argv=None):
         # forcing it on matches any recorded run's digests
         overrides["run.resume"] = True
         overrides["run.obs.digest.enabled"] = True
+    if args.cmd == "preflight":
+        # the preflight IS the executable registry — force it on even
+        # when the config under test disables observability
+        overrides["run.obs.executables"] = True
     try:
         cfg = resolve_config(args.config, overrides)
     except (KeyError, ValueError, FileNotFoundError) as e:
@@ -668,6 +688,27 @@ def main(argv=None):
         # runtime errors below still surface with full tracebacks
         print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
         return 2
+    if args.cmd == "preflight":
+        from colearn_federated_learning_tpu.obs.executables import (
+            HbmBudgetError,
+            format_preflight_report,
+        )
+
+        try:
+            report = exp.preflight()
+        except HbmBudgetError as e:
+            # names the offending program + its dominant buffers
+            print(f"preflight: {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(format_preflight_report(report))
+        budget = report["hbm_budget_bytes"]
+        return 1 if budget and report["predicted_peak_bytes"] > budget else 0
     if args.cmd == "replay":
         try:
             report = exp.replay_round(args.replay_round)

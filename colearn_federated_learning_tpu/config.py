@@ -861,6 +861,25 @@ class ObsConfig:
     #                      run.max_retries: a NaN run re-NaNs)
     #   checkpoint_abort — save a post-mortem checkpoint first
     on_unhealthy: str = "warn"  # warn | abort | checkpoint_abort
+    # Compiled-program observatory (obs/executables.py): route every
+    # engine/eval jit through an AOT executable registry and record,
+    # per compiled program, XLA's own cost_analysis FLOPs,
+    # memory_analysis argument/output/temp bytes, the donation map, a
+    # stable fingerprint, and compile wall-ms (`executable_compiled`
+    # records), plus per-flush `hbm_watermark` records and `retrace`
+    # forensics naming the argument whose shape/dtype/sharding
+    # changed. Execution is the SAME lowering jit would produce —
+    # bitwise-identical results, test-pinned. Off = jit dispatch
+    # untouched, records absent.
+    executables: bool = True
+    # 0 = off; otherwise any newly compiled program whose predicted
+    # peak HBM (argument + output + temp + generated-code bytes,
+    # donation-aliased buffers counted once) exceeds this many MiB
+    # aborts the fit with HbmBudgetError BEFORE the program executes
+    # (deliberately not retried — recompiling predicts the same peak).
+    # `colearn preflight` applies the same ceiling without executing
+    # anything. Requires executables.
+    hbm_budget_mb: int = 0
     # Per-client forensic ledger — see ClientLedgerConfig.
     client_ledger: ClientLedgerConfig = field(
         default_factory=ClientLedgerConfig
@@ -2268,6 +2287,16 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown run.obs.phase_cost_flops "
                 f"{obs.phase_cost_flops!r}; expected 'analytic' or 'xla'"
+            )
+        if obs.hbm_budget_mb < 0:
+            raise ValueError(
+                f"run.obs.hbm_budget_mb must be >= 0, "
+                f"got {obs.hbm_budget_mb}"
+            )
+        if obs.hbm_budget_mb > 0 and not obs.executables:
+            raise ValueError(
+                "run.obs.hbm_budget_mb requires run.obs.executables "
+                "(the budget check reads the registry's predicted peaks)"
             )
         dg = obs.digest
         if dg.every < 1:

@@ -85,10 +85,14 @@ PHASES = (
 # Span phases that do NOT count as host-exposed time: `round` is the
 # parent bracket, `round.dispatch` is where async device execution is
 # buried, and `compile` fires INSIDE the dispatch call that triggered
-# it (counting it again would double-book that wall). Every other span
-# (host_inputs, placement, fetch, eval, checkpoint, stream_slab, ...)
-# is host time the device sits idle through.
-_NON_HOST_EXPOSED_SPANS = ("round", "round.dispatch", "compile")
+# it (counting it again would double-book that wall). The executable
+# registry's own spans (`obs.executables` AOT lower+compile and
+# `obs.preflight`) bracket compile work the `compile` listener already
+# books — counting them would charge each compilation twice. Every
+# other span (host_inputs, placement, fetch, eval, checkpoint,
+# stream_slab, ...) is host time the device sits idle through.
+_NON_HOST_EXPOSED_SPANS = ("round", "round.dispatch", "compile",
+                           "obs.executables", "obs.preflight")
 
 # Attribution sub-spans nested INSIDE an already-counted host span: the
 # parent's bracket (`round.host_inputs`) contains their wall time, so
@@ -459,10 +463,17 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     rps: List[float] = []
     padded: List[float] = []
     rounds = 0
+    exec_recs: Dict[str, Dict[str, Any]] = {}
     for rec in records:
         ev = rec.get("event")
         if ev == "phase_cost_model":
             model = rec
+        elif ev == "executable_compiled":
+            # the registry's HLO-derived truth (latest compile per
+            # program wins — retraces refresh the measured flops);
+            # preflight compiles are abstract rehearsals, not the run
+            if not rec.get("preflight"):
+                exec_recs[str(rec.get("name"))] = rec
         elif ev == "phase_cost":
             costs_n += 1
             for name, c in (rec.get("phases") or {}).items():
@@ -517,6 +528,33 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 host_sub_ms[name[len(pref):]] = (
                     span_ms[name] / max(1, rounds)
                 )
+    # measured-vs-analytic drift: the XLA cost_analysis flops of the
+    # dominant round program (per round — fused programs carry
+    # rounds_per_call) against the analytic model's per-round total.
+    # A pre-PR-20 log has no executable_compiled records: the section
+    # is None and every consumer renders n/a, never a KeyError.
+    analytic_round = sum(c["flops"] for c in costs.values())
+    round_progs: Dict[str, float] = {}
+    for name, rec in exec_recs.items():
+        fl = rec.get("flops")
+        if fl is None or not name.startswith("round."):
+            continue
+        per_call = max(1, int(rec.get("rounds_per_call") or 1))
+        round_progs[name] = float(fl) / per_call
+    measured = None
+    if round_progs:
+        prog = max(round_progs, key=lambda n: round_progs[n])
+        m_flops = round_progs[prog]
+        measured = {
+            "programs": {n: round_progs[n] for n in sorted(round_progs)},
+            "round_program": prog,
+            "round_flops_measured": m_flops,
+            "round_flops_analytic": float(analytic_round),
+            "flop_model_drift_pct": (
+                100.0 * (m_flops - analytic_round) / analytic_round
+                if analytic_round else None
+            ),
+        }
     rps_mean = sum(rps) / len(rps)
     wf = waterfall(
         costs, rps_mean, peak, n_chips=n_chips,
@@ -549,6 +587,7 @@ def mfu_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "roofline": roofline,
         "host_exposed_ms_per_round": host_ms,
         "host_exposed_sub_ms_per_round": host_sub_ms,
+        "measured": measured,
         # cohort-layout attribution (runs predating the layout fields
         # render n/a — never a KeyError)
         "layout": {
@@ -618,11 +657,12 @@ def format_mfu_report(report: Dict[str, Any], path: str = "") -> str:
     for v in report["identity_violations"]:
         lines.append(f"  WARNING: {v}")
     roof = report.get("roofline") or {}
+    meas = report.get("measured") or {}
     if roof:
         lines.append("")
         lines.append(
             f"{'phase':<18}{'flops/round':>14}{'bytes/round':>14}"
-            f"{'flops/byte':>12}{'bound':>9}{'us@peak':>10}"
+            f"{'flops/byte':>12}{'bound':>9}{'us@peak':>10}{'measured':>13}"
         )
         for name in PHASES:
             if name not in roof:
@@ -630,9 +670,32 @@ def format_mfu_report(report: Dict[str, Any], path: str = "") -> str:
             r = roof[name]
             inten = ("inf" if r["intensity"] is None
                      else f"{r['intensity']:.1f}")
+            # measured flops exist at PROGRAM granularity (XLA fuses
+            # the whole round into one executable), so phase rows carry
+            # the analytic model and the join lands on the total row
             lines.append(
                 f"{name:<18}{r['flops']:>14.3g}{r['bytes']:>14.3g}"
                 f"{inten:>12}{r['bound']:>9}{r['time_us_at_peak']:>10.1f}"
+                f"{'n/a':>13}"
+            )
+        if meas:
+            drift = meas.get("flop_model_drift_pct")
+            lines.append(
+                f"{'round total':<18}"
+                f"{meas['round_flops_analytic']:>14.3g}"
+                f"{'':>14}{'':>12}{'':>9}{'':>10}"
+                f"{meas['round_flops_measured']:>13.3g}"
+            )
+            lines.append(
+                f"measured vs analytic flops/round "
+                f"({meas['round_program']}, XLA cost_analysis): "
+                f"drift {_na(drift, '{:+.2f}%')}"
+            )
+        else:
+            lines.append(
+                "measured flops: n/a (no executable_compiled records — "
+                "run predates the executable registry or "
+                "run.obs.executables was off)"
             )
     return "\n".join(lines)
 
@@ -700,6 +763,9 @@ def load_bench_history(bench_dir: str) -> List[Dict[str, Any]]:
             # predating the knob (r01–r05) render n/a
             "control_plane": extra.get("control_plane"),
             "host_exposed_pct": extra.get("host_exposed_pct"),
+            # measured-vs-analytic flop drift (executable registry,
+            # ISSUE 20): r01–r19 entries predate the extra → n/a
+            "flop_model_drift_pct": extra.get("flop_model_drift_pct"),
             "weak_scale": _tail_weak_scale_records(doc, parsed),
             "async_throughput": _tail_async_records(doc, parsed),
             "store_gather": _tail_store_records(doc, parsed),
@@ -911,6 +977,21 @@ def bench_report(entries: Sequence[Dict[str, Any]],
             violations.append(
                 f"host_exposed_pct {latest['host_exposed_pct']:.1f} "
                 f"> budget ceiling {float(host_max):.1f} "
+                f"({latest['file']})"
+            )
+        # measured-vs-analytic flop drift ceiling: the cost-model truth
+        # gate — |drift| over budget means the analytic phase model and
+        # the XLA cost_analysis of the compiled round program no longer
+        # agree. Fires only when the entry carries the extra (r01–r19
+        # histories render n/a, never a gate)
+        drift_max = budgets.get("flop_drift_pct_max")
+        if (drift_max is not None
+                and latest.get("flop_model_drift_pct") is not None
+                and abs(latest["flop_model_drift_pct"]) > float(drift_max)):
+            violations.append(
+                f"flop_model_drift_pct "
+                f"{latest['flop_model_drift_pct']:+.2f} exceeds "
+                f"± budget ceiling {float(drift_max):.2f} "
                 f"({latest['file']})"
             )
         for ph, ms in (latest.get("phase_ms_per_round") or {}).items():

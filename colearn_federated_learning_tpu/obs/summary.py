@@ -83,11 +83,44 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     rps: List[float] = []
     last_eval: Dict[str, float] = {}
     precision: Dict[str, Any] = {}
+    executables: Dict[str, Dict[str, Any]] = {}
+    retraces: List[Dict[str, Any]] = []
+    hbm_peak_bytes = 0
+    hbm_peak_program = None
     dropped = stragglers = byzantine = 0
     for rec in records:
         ev = rec.get("event")
         if ev:
             events[ev] = events.get(ev, 0) + 1
+        if ev == "executable_compiled":
+            # the registry's per-program compile ledger (PR 20); a
+            # preflight rehearsal's compiles are not this run's
+            if rec.get("preflight"):
+                continue
+            cur = executables.setdefault(str(rec.get("name", "?")), {
+                "compiles": 0, "compile_ms": 0.0, "flops": None,
+                "peak_bytes": None,
+            })
+            cur["compiles"] += 1
+            cur["compile_ms"] += float(rec.get("compile_ms") or 0.0)
+            if rec.get("flops") is not None:
+                cur["flops"] = float(rec["flops"])
+            if rec.get("peak_bytes") is not None:
+                cur["peak_bytes"] = int(rec["peak_bytes"])
+            continue
+        if ev == "retrace":
+            retraces.append({
+                "round": rec.get("round"),
+                "name": rec.get("name"),
+                "changed": rec.get("changed") or [],
+            })
+            continue
+        if ev == "hbm_watermark":
+            wb = int(rec.get("watermark_bytes") or 0)
+            if wb > hbm_peak_bytes:
+                hbm_peak_bytes = wb
+                hbm_peak_program = rec.get("program")
+            continue
         if ev == "precision":
             # dtype/fusion provenance logged at fit start — surfaced so
             # a throughput read-off carries its compute_dtype column
@@ -136,12 +169,21 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     if rps:
         out["rounds_per_sec_mean"] = sum(rps) / len(rps)
+    if executables:
+        out["executables"] = executables
+    if retraces:
+        out["retraces"] = retraces
     if run_sum is not None:
         out["rounds"] = max(rounds, int(run_sum.get("rounds", 0)))
         if "wall_time_sec" in run_sum:
             out["wall_time_sec"] = float(run_sum["wall_time_sec"])
         if "compiles" in run_sum:
             out["compiles"] = int(run_sum["compiles"])
+        # the run_summary HBM peak (driver-tracked across the whole
+        # run) is authoritative over the per-flush watermarks
+        if run_sum.get("hbm_peak_bytes") is not None:
+            hbm_peak_bytes = int(run_sum["hbm_peak_bytes"])
+            hbm_peak_program = run_sum.get("hbm_peak_program")
         counters = {
             k: int(run_sum[k]) for k in _COUNTER_KEYS if k in run_sum
         }
@@ -192,6 +234,9 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             out["hier_core_upload_bytes"] = int(
                 run_sum["hier_core_upload_bytes"]
             )
+    if hbm_peak_bytes:
+        out["hbm_peak"] = {"bytes": hbm_peak_bytes,
+                           "program": hbm_peak_program}
     if counters:
         out["comm"] = counters
     if dropped or stragglers or byzantine:
@@ -207,6 +252,18 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     if precision:
         out["precision"] = precision
     return out
+
+
+def _desc_short(d) -> str:
+    """Compact render of a registry leaf descriptor (("a", shape,
+    dtype, weak, sharding) after a JSON round-trip) for the retrace
+    table; anything unrecognized prints truncated, never raises."""
+    if isinstance(d, (list, tuple)) and len(d) >= 3 and d[0] == "a":
+        try:
+            return f"{tuple(d[1])}:{d[2]}"
+        except TypeError:
+            pass
+    return "absent" if d is None else str(d)[:48]
 
 
 def _fmt_bytes(n: int) -> str:
@@ -271,6 +328,56 @@ def format_summary(summary: Dict[str, Any], path: str = "") -> str:
             )
     else:
         lines.append("no span records (run.obs.spans was off, or pre-obs run)")
+    execs = summary.get("executables")
+    if execs:
+        # the per-executable compile ledger (registry records): what
+        # compiled, how often, how long, and the HLO-derived flops —
+        # this table supersedes the bare compile-count line below
+        lines.append("")
+        lines.append(
+            f"{'executable':<24}{'compiles':>9}{'wall ms':>10}"
+            f"{'flops':>16}{'peak MiB':>10}"
+        )
+        for name in sorted(execs, key=lambda n: -execs[n]["compile_ms"]):
+            e = execs[name]
+            flops = ("n/a" if e["flops"] is None
+                     else format(int(e["flops"]), ","))
+            peak = ("n/a" if e["peak_bytes"] is None
+                    else f"{e['peak_bytes'] / 2**20:.1f}")
+            lines.append(
+                f"{name:<24}{e['compiles']:>9}{e['compile_ms']:>10.1f}"
+                f"{flops:>16}{peak:>10}"
+            )
+        hbm = summary.get("hbm_peak")
+        if hbm:
+            lines.append(
+                f"hbm peak: {hbm['bytes'] / 2**20:.1f} MiB "
+                f"({hbm.get('program') or 'n/a'})"
+            )
+    elif "compiles" in summary:
+        # pre-PR-20 log: the run_summary compile count is all there is
+        lines.append(
+            f"compiles: {summary['compiles']} (per-executable table "
+            "n/a — log predates the executable registry)"
+        )
+    rets = summary.get("retraces")
+    if rets:
+        lines.append("")
+        lines.append("retraces (recompiles of a seen program — each "
+                     "names the argument that changed):")
+        lines.append(f"{'round':>6}  {'executable':<22}changed")
+        for r in rets[:20]:
+            changed = "; ".join(
+                f"{c.get('arg', '?')}: {_desc_short(c.get('before'))}"
+                f" -> {_desc_short(c.get('after'))}"
+                for c in (r.get("changed") or [])
+            ) or "n/a"
+            lines.append(
+                f"{r.get('round') or 0:>6}  {str(r.get('name', '?')):<22}"
+                f"{changed}"
+            )
+        if len(rets) > 20:
+            lines.append(f"  ... {len(rets) - 20} more retraces")
     comm = summary.get("comm")
     if comm:
         lines.append("")

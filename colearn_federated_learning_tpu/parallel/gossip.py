@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from colearn_federated_learning_tpu.client.trainer import make_local_train_fn
+from colearn_federated_learning_tpu.obs.executables import instrument
 from colearn_federated_learning_tpu.parallel.mesh import CLIENT_AXIS, has_batch_axis
 from jax.sharding import PartitionSpec as P
 
@@ -371,4 +372,4 @@ def make_gossip_round_fn(model, client_cfg, dp_cfg, task, mesh,
             out["loss"], out["n"], out["consensus"]
         )
 
-    return round_fn
+    return instrument("round.gossip", round_fn)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from colearn_federated_learning_tpu.client.trainer import make_local_train_fn
+from colearn_federated_learning_tpu.obs.executables import instrument
 from colearn_federated_learning_tpu.parallel.mesh import (
     BATCH_AXIS,
     CLIENT_AXIS,
@@ -1587,7 +1588,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             return (new_params, new_opt_state, new_c_global, out["c_all"],
                     RoundMetrics(out["loss"], out["n"]))
 
-        return round_fn
+        return instrument("round.stateful", round_fn)
 
     if error_feedback:
 
@@ -1673,7 +1674,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                     return p, o, e, led, ms
                 return p, o, e, ms  # RoundMetrics with [F]-stacked fields
 
-            return round_fn
+            return instrument("round.ef_fused", round_fn,
+                              rounds_per_call=fuse_rounds)
 
         _ef_donate1 = (0, 1, 8) + ((10,) if client_ledger else ())
 
@@ -1685,7 +1687,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                                  idx, mask, n_ex, rng, e_clients, cohort,
                                  ledger)
 
-        return round_fn
+        return instrument("round.ef", round_fn)
 
     if secagg:
 
@@ -1723,7 +1725,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 )
             return new_params, new_opt_state, RoundMetrics(out["loss"], out["n"])
 
-        return round_fn
+        return instrument("round.secagg", round_fn)
 
     def _one_round(params, server_opt_state, train_x, train_y, idx, mask,
                    n_ex, rng, byz=None, ledger=None, cohort=None):
@@ -1860,7 +1862,8 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
                 return p, o, led, ms
             return p, o, ms  # RoundMetrics with [F]-stacked fields
 
-        return round_fn
+        return instrument("round.fused", round_fn,
+                          rounds_per_call=fuse_rounds)
 
     # keep the compiled program's name "jit_round_fn": profiling tools
     # (bench._parse_device_ms) identify the round program by it
@@ -1871,7 +1874,7 @@ def make_sharded_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
     round_fn = partial(jax.jit, donate_argnums=_donate if donate else ())(
         _one_round
     )
-    return round_fn
+    return instrument("round.sync", round_fn)
 
 
 def make_device_round_fn(round_fn, schedule_fn, fuse, *, client_ledger=False,
@@ -1940,7 +1943,8 @@ def make_device_round_fn(round_fn, schedule_fn, fuse, *, client_ledger=False,
                            idx_f, spec_f, n_ex_f, rngs, None, *tail)
             return out + ({k: sched[k] for k in _sched_out},)
 
-        return device_round_fn
+        return instrument("round.device_fused", device_round_fn,
+                          rounds_per_call=fuse)
 
     @partial(jax.jit, donate_argnums=_dev_donate if donate else ())
     def device_round_fn(params, server_opt_state, train_x, train_y,
@@ -1962,7 +1966,7 @@ def make_device_round_fn(round_fn, schedule_fn, fuse, *, client_ledger=False,
                        idx, spec, n_ex, rng, None, *tail)
         return out + ({k: sched[k] for k in _sched_out},)
 
-    return device_round_fn
+    return instrument("round.device", device_round_fn)
 
 
 def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
@@ -2204,7 +2208,7 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
             return (new_history, new_params, new_opt_state, new_ledger,
                     RoundMetrics(mean_loss, n_total))
 
-        return ledger_round_fn
+        return instrument("round.fedbuff_ledger", ledger_round_fn)
 
     def lane_fn(history, train_x, train_y, idx, mask, agg_w, n_ex, slots,
                 keys, *rest):
@@ -2308,7 +2312,7 @@ def make_async_round_fn(model, client_cfg, dp_cfg, task, mesh, server_update,
         return (new_history, new_params, new_opt_state,
                 RoundMetrics(mean_loss, n_total))
 
-    return round_fn
+    return instrument("round.fedbuff", round_fn)
 
 
 def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
@@ -2401,14 +2405,18 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
 
     compress = make_compressor(compression, topk_ratio, qsgd_levels,
                                topk_exact=topk_exact)
-    local_train = jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
-                                              local_dtype=local_dtype,
-                                              scan_unroll=scan_unroll))
-    update = jax.jit(server_update)
+    local_train = instrument(
+        "seq.local_train",
+        jax.jit(make_local_train_fn(model, client_cfg, dp_cfg, task,
+                                    local_dtype=local_dtype,
+                                    scan_unroll=scan_unroll)),
+    )
+    update = instrument("seq.server_apply", jax.jit(server_update))
     # the fused stacked-path entry, jitted ONCE at the factory (the
     # interpret-mode kernel would otherwise re-trace eagerly per round)
     fused_reduce = (
-        jax.jit(server_update.fused_reduce) if fused_apply else None
+        instrument("seq.fused_reduce", jax.jit(server_update.fused_reduce))
+        if fused_apply else None
     )
 
     use_decay = client_cfg.lr_decay != 1.0
@@ -2417,7 +2425,9 @@ def make_sequential_round_fn(model, client_cfg, dp_cfg, task, server_update,
     # wrapper created inside round_fn would re-compile every ROUND —
     # the cache lives with the wrapper, so it must outlive the round
     pairwise_up = (
-        jax.jit(_secagg_pairwise_upload, static_argnums=(7, 8))
+        instrument("seq.secagg_upload",
+                   jax.jit(_secagg_pairwise_upload, static_argnums=(7, 8)),
+                   static_argnums=(7, 8))
         if secagg and secagg_mode == "pairwise" else None
     )
 

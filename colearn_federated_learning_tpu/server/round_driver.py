@@ -47,6 +47,11 @@ from colearn_federated_learning_tpu.obs import (
     round_shape_stats,
 )
 from colearn_federated_learning_tpu.obs import digest as digest_mod
+from colearn_federated_learning_tpu.obs import executables as exec_mod
+from colearn_federated_learning_tpu.obs.executables import (
+    ExecutableRegistry,
+    HbmBudgetError,
+)
 from colearn_federated_learning_tpu.obs.roofline import (
     PEAK_HBM_BYTES_PER_SEC,
     analytic_lora_step_flops,
@@ -743,7 +748,7 @@ class Experiment:
         if self._cp_device:
             self._init_device_plane()
         eval_fn = make_eval_fn(self.model, self.task)
-        self._eval_fn = jax.jit(eval_fn)
+        self._eval_fn = exec_mod.instrument("eval.task", jax.jit(eval_fn))
 
         # Federated (per-client) eval as ONE dispatch: nested lax.scan —
         # outer over clients, inner over each client's padded batch stack
@@ -763,7 +768,9 @@ class Experiment:
             _, (c, n) = jax.lax.scan(per_client, None, (xs, ys, ms))
             return c, n  # per-client correct/example counts, [n_clients]
 
-        self._fed_eval_all = jax.jit(_fed_eval_all)
+        self._fed_eval_all = exec_mod.instrument(
+            "eval.fed_all", jax.jit(_fed_eval_all)
+        )
 
         # Full-test-set eval as ONE dispatch: lax.scan over the stacked
         # eval batches instead of one jitted call per batch — at ImageNet
@@ -781,7 +788,7 @@ class Experiment:
             )
             return acc
 
-        self._eval_all = jax.jit(_eval_all)
+        self._eval_all = exec_mod.instrument("eval.all", jax.jit(_eval_all))
         # eval batches are fixed for the run: build + upload exactly once
         xb, yb, mb = eval_batches(
             self.fed.test_x, self.fed.test_y, cfg.client.batch_size
@@ -827,6 +834,20 @@ class Experiment:
         self.health = (
             HealthMonitor(obs.divergence_factor) if obs.health else None
         )
+        # Compiled-program observatory (run.obs.executables): the
+        # per-fit AOT registry — installed around fit() so the engines'
+        # instrumented jit sites route through it; drained into
+        # `executable_compiled`/`retrace`/`hbm_watermark` records at
+        # flush boundaries. The same lowering jit would produce —
+        # registry-on is bitwise-identical to registry-off
+        # (test-pinned).
+        self._exec_reg: Optional[ExecutableRegistry] = None
+        if obs.executables:
+            self._exec_reg = ExecutableRegistry(
+                hbm_budget_bytes=obs.hbm_budget_mb * 2**20,
+                device_capacity_bytes=exec_mod.device_hbm_capacity(),
+                tracer=self.tracer,
+            )
         self._counters_on = obs.counters
         # analytic per-phase FLOP/HBM-byte cost records (obs/roofline):
         # pure function of config + realized grid, so both engines (and
@@ -1243,6 +1264,58 @@ class Experiment:
                 f"check."
             )
 
+    def preflight(self) -> Dict[str, Any]:
+        """OOM preflight (``colearn preflight``): walk ONE round of the
+        real dispatch path with a preflight-mode executable registry —
+        every instrumented jit site lowers and compiles (XLA memory
+        analysis = the predicted peak) but returns abstract
+        ``ShapeDtypeStruct`` outputs instead of executing, so output
+        and temp buffers are never allocated. Host-side inputs (params,
+        cohort slabs) ARE staged — they must fit anyway for the run to
+        start; the unknown the preflight answers is the program's
+        working set. Returns the registry's report (predicted peak
+        bytes + per-program dominant buffers); raises
+        :class:`HbmBudgetError` when ``run.obs.hbm_budget_mb`` is set
+        and exceeded.
+
+        Requires a fully-jitted round program: the sequential oracle's
+        eager python loop cannot run on abstract values."""
+        if self.cfg.run.engine != "sharded":
+            raise ValueError(
+                "preflight requires run.engine=sharded: the sequential "
+                "oracle's eager per-client loop cannot run on abstract "
+                "outputs"
+            )
+        obs = self.cfg.run.obs
+        reg = ExecutableRegistry(
+            preflight=True,
+            hbm_budget_bytes=obs.hbm_budget_mb * 2**20,
+            device_capacity_bytes=exec_mod.device_hbm_capacity(),
+            tracer=self.tracer,
+        )
+        prev = exec_mod.current()
+        exec_mod.install(reg)
+        try:
+            state = self._place_state(self.init_state())
+            try:
+                self.run_round(state, 0)
+            except HbmBudgetError:
+                raise
+            except Exception:
+                # post-dispatch host unwinding on abstract outputs
+                # (metric slicing, store scatter) is expected to fail —
+                # the programs were already captured at that point. An
+                # empty registry means the dispatch itself never
+                # lowered: that IS the preflight failure.
+                if not reg.preflight_report()["programs"]:
+                    raise
+        finally:
+            if prev is not None:
+                exec_mod.install(prev)
+            else:
+                exec_mod.uninstall()
+        return reg.preflight_report()
+
     def _local_dtype(self):
         d = self.cfg.run.local_param_dtype
         return _DTYPES[d] if d else None
@@ -1623,6 +1696,10 @@ class Experiment:
 
         @contextmanager
         def span():
+            if self._exec_reg is not None:
+                # every dispatch site enters this span — the registry's
+                # records carry the round they were compiled on
+                self._exec_reg.round = round_idx + 1
             if self._bucket_ladder is None or steps in self._seen_buckets:
                 yield
                 return
@@ -3820,6 +3897,10 @@ class Experiment:
                 baseline_step = store.latest_step()
                 store.close()
         retries = 0
+        if self._exec_reg is not None:
+            # fit-scoped: sequential fits on other Experiment instances
+            # must not route through this registry's cache
+            exec_mod.install(self._exec_reg)
         try:
             while True:
                 try:
@@ -3837,6 +3918,11 @@ class Experiment:
                     # skips verification (its own log tail is expected
                     # to disagree), so retrying would silently bypass
                     # the --strict-digest contract
+                    raise
+                except HbmBudgetError:
+                    # the over-budget verdict is a property of the
+                    # compiled program, not a transient failure —
+                    # recompiling predicts the same peak
                     raise
                 except Exception as e:  # noqa: BLE001 — failure recovery (§5)
                     if retries >= self.cfg.run.max_retries:
@@ -3872,6 +3958,16 @@ class Experiment:
                     state = restored
         finally:
             self._stop_prefetch()
+            if self._exec_reg is not None:
+                exec_mod.uninstall()
+                # abort paths can leave queued registry records behind
+                # the last flush boundary — the JSONL gets them anyway
+                try:
+                    for _rec in self._exec_reg.drain_records():
+                        self.logger.log(_rec)
+                except Exception as e:
+                    print(f"executable record flush failed: {e}",
+                          flush=True)
             if self._ledger_on and self._ledger_ref is not None:
                 # final (or abort-path partial) ledger flush — same
                 # every-exit-path guarantee as the trace export below
@@ -3965,6 +4061,16 @@ class Experiment:
                         self._pager,
                         (self.fed.train_x, self.fed.train_y),
                     ) if self._population is not None else {}),
+                    # compiled-program observatory: the run's predicted
+                    # HBM high-water mark and which program set it
+                    **({
+                        "hbm_peak_bytes": int(self._exec_reg.peak_bytes),
+                        "hbm_peak_program": self._exec_reg.peak_program,
+                        "executables_compiled": int(
+                            self._exec_reg.total_compiles
+                        ),
+                    } if self._exec_reg is not None
+                        and self._exec_reg.peak_program else {}),
                 })
             except Exception as e:
                 print(f"run_summary log failed: {e}", flush=True)
@@ -4287,6 +4393,14 @@ class Experiment:
                     self.logger.log(
                         {"event": "device_memory", "round": last_round, **mem}
                     )
+            if self._exec_reg is not None:
+                # registry-built records (executable_compiled / retrace
+                # / warning) + this window's HBM high-water mark
+                for rec in self._exec_reg.drain_records():
+                    self.logger.log(rec)
+                wm = self._exec_reg.watermark(last_round)
+                if wm is not None:
+                    self.logger.log(wm)
             self._log_population(last_round)
 
         def unhealthy(events, current_state):
@@ -5015,10 +5129,13 @@ class Experiment:
             # built once — jax.jit retraces per input shape on its own;
             # local_dtype matches the run so the personalization metric
             # is measured under the precision clients actually train with
-            self._personal_train = jax.jit(make_local_train_fn(
-                self.model, self.cfg.client, DPConfig(), self.task,
-                local_dtype=self._local_dtype(),
-            ))
+            self._personal_train = exec_mod.instrument(
+                "personal.local_train",
+                jax.jit(make_local_train_fn(
+                    self.model, self.cfg.client, DPConfig(), self.task,
+                    local_dtype=self._local_dtype(),
+                )),
+            )
 
         pers, base = [], []
         # clients stream through iter_client_slabs (store-coalesced

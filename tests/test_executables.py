@@ -1,0 +1,426 @@
+"""Compiled-program observatory (run.obs.executables, obs/executables.py):
+AOT registry records + HBM watermarks, the bitwise no-op contract,
+fingerprint/flop rerun parity across {sharded, sequential} × {fuse 1, 4},
+CPU degradation to partial records, the OOM preflight (driver + CLI +
+budget abort), retrace forensics on the shape-bucket ladder, and the
+measured-vs-analytic flop drift surfaces (`colearn mfu` column,
+`bench-report` gate)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import cli
+from colearn_federated_learning_tpu.config import get_named_config
+from colearn_federated_learning_tpu.obs import executables as exec_mod
+from colearn_federated_learning_tpu.obs.executables import (
+    ExecutableRegistry,
+    HbmBudgetError,
+    format_preflight_report,
+    instrument,
+)
+from colearn_federated_learning_tpu.obs.roofline import (
+    bench_report,
+    format_mfu_report,
+    load_bench_history,
+    mfu_report,
+)
+from colearn_federated_learning_tpu.obs.summary import (
+    format_summary,
+    load_records,
+    summarize_records,
+)
+from colearn_federated_learning_tpu.server.round_driver import Experiment
+
+
+def _tiny_cfg(out="", engine="sharded", fuse=1, rounds=2, **over):
+    cfg = get_named_config("mnist_fedavg_2")
+    cfg.data.synthetic_train_size = 256
+    cfg.data.synthetic_test_size = 64
+    cfg.data.max_examples_per_client = 64
+    cfg.client.batch_size = 16
+    cfg.server.cohort_size = 2
+    cfg.server.num_rounds = rounds
+    cfg.server.eval_every = 0
+    cfg.server.checkpoint_every = 0
+    cfg.run.out_dir = out
+    cfg.run.engine = engine
+    cfg.run.fuse_rounds = fuse
+    cfg.run.metrics_flush_every = 1
+    for k, v in over.items():
+        cfg.apply_overrides({k: v})
+    return cfg.validate()
+
+
+def _fit(cfg):
+    exp = Experiment(cfg, echo=False)
+    state = exp.fit()
+    records = []
+    if cfg.run.out_dir:
+        hits = sorted(
+            (os.path.join(cfg.run.out_dir, f)
+             for f in os.listdir(cfg.run.out_dir)
+             if f.endswith(".metrics.jsonl")),
+            key=os.path.getmtime,
+        )
+        records = load_records(hits[-1])
+    return exp, state, records
+
+
+def _events(records, event):
+    return [r for r in records if r.get("event") == event]
+
+
+# ---------------------------------------------------------------------------
+# registry wrapper unit behavior (no driver)
+# ---------------------------------------------------------------------------
+
+
+def test_instrument_passthrough_without_registry():
+    fn = instrument("unit.addone", jax.jit(lambda x: x + 1))
+    assert exec_mod.current() is None
+    np.testing.assert_array_equal(
+        np.asarray(fn(np.arange(4.0))), np.arange(4.0) + 1
+    )
+
+
+def test_registry_caches_by_shape_and_emits_retrace():
+    reg = ExecutableRegistry()
+    exec_mod.install(reg)
+    try:
+        fn = instrument("unit.scale", jax.jit(lambda x: x * 2.0))
+        a = np.ones((4, 3), np.float32)
+        fn(a)
+        fn(a + 1)  # same avals: cache hit, no recompile
+        compiled = reg.drain_records()
+        assert [r["name"] for r in compiled] == ["unit.scale"]
+        assert len(compiled[0]["fingerprint"]) == 16
+        assert compiled[0]["compile_ms"] > 0
+        # a new shape is a retrace: record names the changed argument
+        fn(np.ones((8, 3), np.float32))
+        recs = reg.drain_records()
+        kinds = {r["event"] for r in recs}
+        assert kinds == {"executable_compiled", "retrace"}
+        ret = next(r for r in recs if r["event"] == "retrace")
+        assert ret["name"] == "unit.scale"
+        assert ret["prev_fingerprint"] == compiled[0]["fingerprint"]
+        assert [c["arg"] for c in ret["changed"]] == ["x"]
+    finally:
+        exec_mod.uninstall()
+
+
+def test_instrumented_program_nests_under_outer_trace():
+    # the device plane inlines instrumented programs under its own jit
+    # trace: the wrapper must pass through (no lowering of tracers)
+    reg = ExecutableRegistry()
+    exec_mod.install(reg)
+    try:
+        inner = instrument("unit.inner", jax.jit(lambda x: x + 1))
+        outer = jax.jit(lambda x: inner(x) * 2)
+        np.testing.assert_array_equal(
+            np.asarray(outer(np.arange(3.0))), (np.arange(3.0) + 1) * 2
+        )
+        assert all(
+            r["name"] != "unit.inner" for r in reg.drain_records()
+            if r.get("event") == "executable_compiled"
+        )
+    finally:
+        exec_mod.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# fit integration: records, watermarks, run_summary, bitwise contract
+# ---------------------------------------------------------------------------
+
+
+def test_fit_emits_records_watermarks_and_run_summary(tmp_path):
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path)))
+    compiled = _events(records, "executable_compiled")
+    names = {r["name"] for r in compiled}
+    assert "round.sync" in names
+    for r in compiled:
+        assert len(r["fingerprint"]) == 16
+        assert r["compile_ms"] > 0
+        assert r["rounds_per_call"] >= 1
+        assert r["preflight"] is False
+    wm = _events(records, "hbm_watermark")
+    assert wm and all(w["watermark_bytes"] > 0 for w in wm)
+    assert any(w.get("program") == "round.sync" for w in wm)
+    run_sum = _events(records, "run_summary")[-1]
+    assert run_sum["hbm_peak_bytes"] > 0
+    assert run_sum["hbm_peak_program"] in names
+    assert run_sum["executables_compiled"] >= len(names)
+    # the registry runs under its own named span, outside round.dispatch
+    span_names = set()
+    for rec in _events(records, "spans"):
+        span_names |= set(rec.get("phases") or {})
+    assert "obs.executables" in span_names
+
+
+def test_registry_on_off_params_bitwise_identical(tmp_path):
+    _, on_state, _ = _fit(_tiny_cfg(out=str(tmp_path / "on")))
+    cfg_off = _tiny_cfg(out=str(tmp_path / "off"))
+    cfg_off.run.obs.executables = False
+    _, off_state, off_records = _fit(cfg_off)
+    assert not _events(off_records, "executable_compiled")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        on_state["params"], off_state["params"],
+    )
+
+
+# sequential × fuse 4 is not a combo: validate() rejects fuse_rounds > 1
+# off the sharded engine, so the realizable matrix has three cells
+@pytest.mark.parametrize("engine,fuse",
+                         [("sharded", 1), ("sharded", 4), ("sequential", 1)])
+def test_fingerprint_flop_columns_parity_on_rerun(tmp_path, engine, fuse):
+    # same config, two runs: the registry streams are pinned
+    # deterministic on fingerprint/flop/memory columns (timing
+    # stripped) — per engine × fuse combo
+    def columns(sub):
+        _, _, records = _fit(_tiny_cfg(
+            out=str(tmp_path / sub), engine=engine, fuse=fuse, rounds=4))
+        compiled = sorted(
+            (r["name"], r["fingerprint"], r["flops"], r["bytes_accessed"],
+             r["peak_bytes"], r["donated_args"], r["rounds_per_call"])
+            for r in _events(records, "executable_compiled")
+        )
+        watermarks = [
+            (w["round"], w["watermark_bytes"], w.get("program"))
+            for w in _events(records, "hbm_watermark")
+        ]
+        retraces = sorted(
+            (r["name"], r["fingerprint"], r["prev_fingerprint"],
+             r["n_changed"], json.dumps(r["changed"]))
+            for r in _events(records, "retrace")
+        )
+        return compiled, watermarks, retraces
+    first = columns("a")
+    assert first[0]  # the combo actually produced registry records
+    assert first == columns("b")
+
+
+def test_degrades_to_partial_records_when_analyses_unavailable(
+        tmp_path, monkeypatch):
+    # a backend without cost/memory analysis: fields go null, training
+    # is never taken down
+    from jax._src import stages
+
+    def unavailable(self, *a, **k):
+        raise NotImplementedError("no analysis on this backend")
+
+    monkeypatch.setattr(stages.Compiled, "cost_analysis", unavailable)
+    monkeypatch.setattr(stages.Compiled, "memory_analysis", unavailable)
+    _, state, records = _fit(_tiny_cfg(out=str(tmp_path)))
+    assert int(state["round"]) == 2
+    compiled = _events(records, "executable_compiled")
+    assert compiled
+    for r in compiled:
+        assert r["flops"] is None
+        assert r["peak_bytes"] is None
+        assert r["compile_ms"] > 0  # the compile itself still happened
+    assert not _events(records, "hbm_watermark")  # nothing to watermark
+
+
+# ---------------------------------------------------------------------------
+# OOM preflight + HBM budget
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_predicts_measured_peak_within_25pct(tmp_path):
+    exp = Experiment(_tiny_cfg(out=str(tmp_path / "pf")), echo=False)
+    report = exp.preflight()
+    predicted = report["predicted_peak_bytes"]
+    assert predicted > 0
+    assert report["predicted_peak_program"] == "round.sync"
+    dom = next(p for p in report["programs"] if p["name"] == "round.sync")
+    assert dom["dominant"]  # names the dominant buffers
+    table = format_preflight_report(report)
+    assert "round.sync" in table and "predicted peak" in table
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path / "fit")))
+    measured = max(
+        w["watermark_bytes"] for w in _events(records, "hbm_watermark")
+    )
+    assert abs(predicted - measured) / measured <= 0.25
+
+
+def test_preflight_rejects_sequential_oracle(tmp_path):
+    exp = Experiment(
+        _tiny_cfg(out=str(tmp_path), engine="sequential"), echo=False)
+    with pytest.raises(ValueError, match="sharded"):
+        exp.preflight()
+
+
+def test_hbm_budget_aborts_fit_at_compile_time(tmp_path):
+    cfg = _tiny_cfg(out=str(tmp_path))
+    cfg.run.obs.hbm_budget_mb = 1  # tiny: every real program exceeds it
+    exp = Experiment(cfg, echo=False)
+    with pytest.raises(HbmBudgetError, match="dominant buffers"):
+        exp.fit()
+
+
+def _preflight_argv(tmp, *extra):
+    return ["preflight", "--config", "mnist_fedavg_2",
+            "--out-dir", str(tmp),
+            "--set", "data.synthetic_train_size=256",
+            "--set", "data.synthetic_test_size=64",
+            "--set", "data.max_examples_per_client=64",
+            "--set", "client.batch_size=16",
+            "--set", "server.cohort_size=2", *extra]
+
+
+def test_preflight_cli_exit_codes(tmp_path, capsys):
+    assert cli.main(_preflight_argv(tmp_path / "ok")) == 0
+    out = capsys.readouterr().out
+    assert "predicted peak" in out and "round.sync" in out
+    # oversized config vs a tiny budget: non-zero, names the dominant
+    # buffer on stderr
+    assert cli.main(_preflight_argv(
+        tmp_path / "over", "--set", "run.obs.hbm_budget_mb=1")) == 1
+    err = capsys.readouterr().err
+    assert "dominant buffers" in err and "round.sync" in err
+    # the sequential oracle cannot preflight: distinct exit code
+    assert cli.main(_preflight_argv(
+        tmp_path / "seq", "--set", "run.engine=sequential")) == 2
+
+
+# ---------------------------------------------------------------------------
+# retrace forensics: the shape-bucket ladder documents itself
+# ---------------------------------------------------------------------------
+
+
+def test_shape_bucket_retraces_name_the_step_grid_arg(tmp_path):
+    cfg = _tiny_cfg(out=str(tmp_path), rounds=6)
+    cfg.data.num_clients = 8
+    cfg.data.partition = "dirichlet"
+    cfg.data.dirichlet_alpha = 0.3
+    cfg.client.batch_size = 8
+    cfg.run.host_pipeline = "numpy"
+    cfg.run.shape_buckets.enabled = True
+    cfg.run.shape_buckets.base = 2.0
+    cfg.run.shape_buckets.count = 3
+    cfg.validate()
+    _, _, records = _fit(cfg)
+    retraces = [r for r in _events(records, "retrace")
+                if r["name"] == "round.sync"]
+    assert retraces  # the ladder realized more than one rung
+    for r in retraces:
+        assert r["fingerprint"] != r["prev_fingerprint"]
+        # each rung's retrace names the step-grid argument
+        assert "idx" in [c["arg"] for c in r["changed"]]
+    table = format_summary(summarize_records(records))
+    assert "retraces" in table and "idx" in table
+
+
+# ---------------------------------------------------------------------------
+# summarize: compile table + n/a fallback
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_compile_table(tmp_path):
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path)))
+    table = format_summary(summarize_records(records))
+    assert "executable" in table and "round.sync" in table
+    assert "hbm peak:" in table
+
+
+def test_summarize_pre_pr20_log_never_keyerrors(tmp_path):
+    # strip every registry artifact: exactly a pre-PR-20 log
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path)))
+    old = []
+    for r in records:
+        if r.get("event") in ("executable_compiled", "hbm_watermark",
+                              "retrace"):
+            continue
+        if r.get("event") == "run_summary":
+            r = {k: v for k, v in r.items()
+                 if not k.startswith("hbm_") and k != "executables_compiled"}
+        old.append(r)
+    summary = summarize_records(old)
+    assert "executables" not in summary
+    table = format_summary(summary)
+    assert "per-executable table n/a" in table
+
+
+# ---------------------------------------------------------------------------
+# measured-vs-analytic drift: mfu column + bench gate
+# ---------------------------------------------------------------------------
+
+
+def test_mfu_measured_column_and_drift(tmp_path):
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path)))
+    report = mfu_report(records)
+    meas = report["measured"]
+    assert meas["round_program"] == "round.sync"
+    assert meas["round_flops_measured"] > 0
+    assert meas["flop_model_drift_pct"] is not None
+    table = format_mfu_report(report)
+    assert "measured" in table and "drift" in table
+    # a pre-PR-20 log renders the column n/a, never a KeyError
+    old = [r for r in records if r.get("event") != "executable_compiled"]
+    report_old = mfu_report(old)
+    assert report_old["measured"] is None
+    assert "measured flops: n/a" in format_mfu_report(report_old)
+
+
+def _write_history(tmp_path, drifts):
+    for i, drift in enumerate(drifts, start=1):
+        extra = {} if drift is None else {"flop_model_drift_pct": drift}
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(
+            {"n": 1, "parsed": {"value": 3.5, "extra": extra}}))
+    return str(tmp_path)
+
+
+def test_flop_drift_gate_fires_only_over_budget(tmp_path):
+    entries = load_bench_history(_write_history(tmp_path, [None, -21.7]))
+    assert entries[0]["flop_model_drift_pct"] is None
+    assert entries[1]["flop_model_drift_pct"] == -21.7
+    assert bench_report(
+        entries, {"flop_drift_pct_max": 40.0})["violations"] == []
+    # the ceiling is on |drift|: -21.7 trips a 10 budget
+    violations = bench_report(
+        entries, {"flop_drift_pct_max": 10.0})["violations"]
+    assert len(violations) == 1
+    assert "flop_model_drift_pct" in violations[0]
+
+
+def test_flop_drift_gate_na_tolerant(tmp_path):
+    # a history that predates the extra (r01–r19): never a gate
+    entries = load_bench_history(_write_history(tmp_path, [None, None]))
+    assert bench_report(
+        entries, {"flop_drift_pct_max": 0.001})["violations"] == []
+
+
+def test_checked_in_history_passes_repo_budgets(capsys):
+    budgets = json.load(open("BENCH_BUDGETS.json"))
+    assert "flop_drift_pct_max" in budgets
+    assert cli.main(["bench-report", "--dir", "."]) == 0
+    assert "gates: PASS" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# observability tax: obs-on keeps host_exposed under the bench ceiling
+# ---------------------------------------------------------------------------
+
+
+def test_obs_on_host_exposed_under_bench_ceiling(tmp_path):
+    from colearn_federated_learning_tpu.obs.roofline import host_exposed_pct
+
+    _, _, records = _fit(_tiny_cfg(out=str(tmp_path), rounds=4))
+    phase_ms = {}
+    for rec in _events(records, "spans"):
+        for name, agg in (rec.get("phases") or {}).items():
+            phase_ms[name] = phase_ms.get(name, 0.0) + float(
+                agg.get("total_ms", 0.0))
+    assert "obs.executables" in phase_ms  # registry work is spanned...
+    run_sum = _events(records, "run_summary")[-1]
+    hep = host_exposed_pct(phase_ms, float(run_sum["wall_time_sec"]))
+    # ...and excluded: the AOT compiles (seconds on this smoke) must
+    # not book as host-exposed time, or obs-on would blow the budget
+    budgets = json.load(open("BENCH_BUDGETS.json"))
+    assert hep is not None
+    assert hep < float(budgets["host_exposed_pct_max"])

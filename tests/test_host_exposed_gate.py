@@ -21,13 +21,18 @@ def test_host_exposed_pct_counts_only_host_spans():
         "round": 1000.0,           # parent bracket — excluded
         "round.dispatch": 700.0,   # device work hides here — excluded
         "compile": 50.0,           # fires inside dispatch — excluded
+        # the registry's compile brackets duplicate the `compile`
+        # pseudo-phase's wall — excluded for the same reason
+        "obs.executables": 40.0,
+        "obs.preflight": 30.0,
         "round.host_inputs": 100.0,
         "round.fetch": 100.0,
     }
     # 200 host ms over a 1 s wall = 20%
     assert host_exposed_pct(phase_ms, 1.0) == 20.0
     assert set(_NON_HOST_EXPOSED_SPANS) == {
-        "round", "round.dispatch", "compile"}
+        "round", "round.dispatch", "compile",
+        "obs.executables", "obs.preflight"}
 
 
 def test_host_exposed_pct_unmeasured_wall_is_none():
